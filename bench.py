@@ -1,0 +1,87 @@
+"""Benchmark: Higgs-class GBDT training throughput on one TPU chip.
+
+Mirrors the reference's headline benchmark (docs/Experiments.rst:108-124 —
+Higgs 10.5M train rows x 28 features, 255 leaves, lr 0.1, max_bin 255;
+130.094 s / 500 iters = 0.260 s/iter on 2x Xeon E5-2690 v4). Data is
+synthetic Higgs-shaped (the real HIGGS file isn't in the image); the cost of
+a boosting iteration depends on (rows, features, bins, leaves), not label
+values, so sec/iter is comparable.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline = reference_sec_per_iter / ours (>1 means faster than the
+reference CPU baseline).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_SEC_PER_ITER = 130.094 / 500  # docs/Experiments.rst:108-124
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=10_500_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--num-leaves", type=int, default=255)
+    ap.add_argument("--max-bin", type=int, default=255)
+    ap.add_argument("--iters", type=int, default=10,
+                    help="timed iterations (after 2 warmup)")
+    ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    args = ap.parse_args()
+
+    import numpy as np
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import lightgbm_tpu as lgb
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev}", file=sys.stderr)
+
+    rng = np.random.RandomState(0)
+    n, f = args.rows, args.features
+    # Higgs-shaped synthetic: continuous physics-like features, binary label
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f)
+    logits = X[:, : f // 2] @ w[: f // 2] + 0.5 * np.sin(X[:, f // 2]) * X[:, 0]
+    y = (logits + rng.logistic(size=n) > 0).astype(np.float32)
+
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y, params={"max_bin": args.max_bin,
+                                         "verbosity": -1})
+    ds.construct()
+    t_construct = time.time() - t0
+    print(f"# dataset construct: {t_construct:.2f}s", file=sys.stderr)
+
+    booster = lgb.Booster(params={
+        "objective": "binary", "num_leaves": args.num_leaves,
+        "learning_rate": 0.1, "max_bin": args.max_bin,
+        "min_data_in_leaf": 100, "min_sum_hessian_in_leaf": 100.0,
+        "verbosity": -1,
+    }, train_set=ds)
+
+    # warmup (compile)
+    for _ in range(2):
+        booster.update()
+    import jax.numpy as jnp
+    booster._boosting.train_score.block_until_ready()
+
+    t0 = time.time()
+    for _ in range(args.iters):
+        booster.update()
+    booster._boosting.train_score.block_until_ready()
+    sec_per_iter = (time.time() - t0) / args.iters
+
+    print(json.dumps({
+        "metric": "higgs10.5M_sec_per_iter",
+        "value": round(sec_per_iter, 4),
+        "unit": "s/iter (10.5M rows x 28 feat, 255 leaves, 255 bins, binary)",
+        "vs_baseline": round(BASELINE_SEC_PER_ITER / sec_per_iter, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,276 @@
+"""Dataset: binned training container + metadata.
+
+Mirrors the reference's Python ``Dataset`` API surface
+(reference: python-package/lightgbm/basic.py:1195+) on top of the core data
+layer (reference: src/io/dataset.cpp Dataset, src/io/metadata.cpp Metadata,
+src/io/dataset_loader.cpp DatasetLoader):
+
+- lazy construction (bin mappers fitted on first use, basic.py:1195),
+- validation sets aligned to the training set's bin mappers via ``reference``
+  (reference: DatasetLoader::LoadFromFileAlignWithOtherDataset,
+  dataset_loader.cpp:262-314),
+- metadata fields label/weight/group/init_score with ``set_field``/
+  ``get_field`` (reference: dataset.h:41-249 Metadata),
+- trivial (single-bin) features dropped from the device matrix the way the
+  reference drops unused features (``used_feature_map_``, dataset.cpp).
+
+The binned matrix lives device-resident as ``[N, F_used]`` uint8/int32 — the
+TPU analog of the reference's FeatureGroup bin storage (dense_bin.hpp), laid
+out row-major for row-blocked histogram kernels. EFB bundling
+(feature_group.h) is unnecessary for dense device storage and is not applied.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import binning
+from .config import Config
+from .ops.split import FeatureMeta
+from .utils import log
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "values"):  # pandas DataFrame/Series
+        data = data.values
+    if hasattr(data, "toarray"):  # scipy sparse
+        data = data.toarray()
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr
+
+
+class Dataset:
+    """Training/validation data container (reference: basic.py Dataset)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List[int], List[str]] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._constructed = False
+        # populated by construct():
+        self.mappers: List[binning.BinMapper] = []
+        self.used_features: np.ndarray = np.array([], dtype=np.int32)
+        self.bins: Optional[jnp.ndarray] = None       # [N, F_used] device
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+
+    # ------------------------------------------------------------ fields
+    def set_label(self, label):
+        self.label = label
+        return self
+
+    def set_weight(self, weight):
+        self.weight = weight
+        return self
+
+    def set_group(self, group):
+        self.group = group
+        return self
+
+    def set_init_score(self, init_score):
+        self.init_score = init_score
+        return self
+
+    def set_field(self, name: str, data):
+        if name == "label":
+            self.label = data
+        elif name == "weight":
+            self.weight = data
+        elif name == "group":
+            self.group = data
+        elif name == "init_score":
+            self.init_score = data
+        else:
+            log.fatal(f"Unknown field: {name}")
+        return self
+
+    def get_field(self, name: str):
+        return {"label": self.get_label(), "weight": self.get_weight(),
+                "group": self.group, "init_score": self.init_score}[name]
+
+    def get_label(self) -> Optional[np.ndarray]:
+        return None if self.label is None else np.asarray(
+            self.label.values if hasattr(self.label, "values") else self.label,
+            dtype=np.float64).reshape(-1)
+
+    def get_weight(self) -> Optional[np.ndarray]:
+        return None if self.weight is None else np.asarray(
+            self.weight, dtype=np.float64).reshape(-1)
+
+    def get_group(self) -> Optional[np.ndarray]:
+        if self.group is None:
+            return None
+        return np.asarray(self.group, dtype=np.int64).reshape(-1)
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self.num_total_features
+
+    def get_feature_names(self) -> List[str]:
+        self.construct()
+        return self._feature_names
+
+    # --------------------------------------------------------- construct
+    def _resolve_categorical(self, num_features: int,
+                             names: List[str]) -> List[int]:
+        cf = self.categorical_feature
+        if cf == "auto" or cf is None:
+            # pandas categorical dtype capture (reference: basic.py:504-568)
+            if hasattr(self.data, "dtypes"):
+                return [i for i, dt in enumerate(self.data.dtypes)
+                        if str(dt) in ("category",)]
+            return []
+        out = []
+        for c in cf:
+            if isinstance(c, str):
+                if c in names:
+                    out.append(names.index(c))
+            else:
+                out.append(int(c))
+        return out
+
+    def construct(self) -> "Dataset":
+        if self._constructed:
+            return self
+        config = Config.from_params(self.params)
+        raw = self.data
+        # pandas categorical columns -> codes
+        if hasattr(raw, "dtypes"):
+            import pandas as pd  # noqa
+            raw = raw.copy()
+            for col in raw.columns:
+                if str(raw[col].dtype) == "category":
+                    raw[col] = raw[col].cat.codes
+        X = _to_2d_float(raw)
+        self.num_data, self.num_total_features = X.shape
+        if self.feature_name == "auto" or self.feature_name is None:
+            if hasattr(self.data, "columns"):
+                self._feature_names = [str(c) for c in self.data.columns]
+            else:
+                self._feature_names = [f"Column_{i}" for i in range(self.num_total_features)]
+        else:
+            self._feature_names = list(self.feature_name)
+
+        if self.reference is not None:
+            ref = self.reference.construct()
+            if self.num_total_features != ref.num_total_features:
+                log.fatal("validation data has different number of features")
+            self.mappers = ref.mappers
+            self.used_features = ref.used_features
+            self._feature_meta = ref._feature_meta
+            self._missing_bin = ref._missing_bin
+            self.max_num_bins = ref.max_num_bins
+        else:
+            cats = self._resolve_categorical(self.num_total_features, self._feature_names)
+            self.mappers = binning.find_bin_mappers(X, config, cats)
+            self.used_features = np.array(
+                [j for j, m in enumerate(self.mappers) if not m.is_trivial],
+                dtype=np.int32)
+            if len(self.used_features) == 0:
+                log.warning("There are no meaningful features, as all feature values"
+                            " are constant.")
+            self._build_feature_meta()
+
+        used = [self.mappers[j] for j in self.used_features]
+        Xu = X[:, self.used_features] if len(self.used_features) else np.zeros((self.num_data, 0))
+        bins_np = binning.bin_data(Xu, used)
+        dtype = np.uint8 if self.max_num_bins <= 256 else np.int32
+        self.bins = jnp.asarray(bins_np.astype(dtype))
+        self._constructed = True
+        if self.free_raw_data:
+            self.data = None
+        total_bins = int(sum(m.num_bin for m in used))
+        log.info(f"Total Bins {total_bins}")
+        log.info(f"Number of data points in the train set: {self.num_data}, "
+                 f"number of used features: {len(self.used_features)}")
+        return self
+
+    def _build_feature_meta(self):
+        used = [self.mappers[j] for j in self.used_features]
+        nb = np.array([m.num_bin for m in used], dtype=np.int32)
+        self.max_num_bins = int(nb.max()) if len(nb) else 2
+        missing = np.array([m.missing_type for m in used], dtype=np.int32)
+        default_bin = np.array([m.default_bin for m in used], dtype=np.int32)
+        is_cat = np.array([m.bin_type == binning.BIN_TYPE_CATEGORICAL for m in used])
+        # missing_bin: the bin routed by the split's default direction, or -1
+        # (mode analysis in ops/split.py docstring)
+        mode_a = (nb > 2) & (missing != binning.MISSING_NONE)
+        missing_bin = np.where(mode_a & (missing == binning.MISSING_NAN), nb - 1,
+                               np.where(mode_a & (missing == binning.MISSING_ZERO),
+                                        default_bin, -1)).astype(np.int32)
+        if is_cat.any():
+            log.warning("categorical feature splits are not implemented yet; "
+                        "categorical columns will not be used for splitting")
+        f = max(len(used), 1)
+        self._feature_meta = FeatureMeta(
+            num_bins=jnp.asarray(nb if len(nb) else np.array([2], np.int32)),
+            missing_type=jnp.asarray(missing if len(missing) else np.zeros(1, np.int32)),
+            default_bin=jnp.asarray(default_bin if len(default_bin) else np.zeros(1, np.int32)),
+            is_categorical=jnp.asarray(is_cat if len(is_cat) else np.zeros(1, bool)),
+            monotone=jnp.zeros((f,), dtype=jnp.int8),
+            penalty=jnp.ones((f,), dtype=jnp.float32),
+        )
+        self._missing_bin = jnp.asarray(missing_bin if len(missing_bin)
+                                        else np.full(1, -1, np.int32))
+
+    # ------------------------------------------------------- helpers
+    @property
+    def feature_meta(self) -> FeatureMeta:
+        self.construct()
+        return self._feature_meta
+
+    @property
+    def missing_bin(self):
+        self.construct()
+        return self._missing_bin
+
+    def num_used_features(self) -> int:
+        self.construct()
+        return max(len(self.used_features), 1)
+
+    def bin_new_data(self, X) -> np.ndarray:
+        """Bin raw features with this dataset's mappers (prediction path)."""
+        self.construct()
+        X = _to_2d_float(X)
+        if X.shape[1] != self.num_total_features:
+            log.fatal(f"The number of features in data ({X.shape[1]}) is not the same"
+                      f" as it was in training data ({self.num_total_features}).")
+        used = [self.mappers[j] for j in self.used_features]
+        Xu = X[:, self.used_features] if len(self.used_features) else np.zeros((len(X), 0))
+        return binning.bin_data(Xu, used)
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, params=params)
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        """Row subset sharing this dataset's mappers (reference: basic.py
+        Dataset.subset / CopySubrow, dataset.h:416). Requires raw data."""
+        if self.data is None:
+            log.fatal("Cannot subset a Dataset whose raw data was freed")
+        idx = np.asarray(used_indices)
+        data = self.data.iloc[idx] if hasattr(self.data, "iloc") else _to_2d_float(self.data)[idx]
+        lbl = self.get_label()
+        w = self.get_weight()
+        return Dataset(data, label=None if lbl is None else lbl[idx],
+                       reference=self,
+                       weight=None if w is None else w[idx],
+                       params=params or self.params)

@@ -1,0 +1,472 @@
+"""Feature quantization (binning).
+
+Host-side re-implementation of the reference BinMapper semantics
+(reference: src/io/bin.cpp:78-520, include/LightGBM/bin.h:61-225):
+
+- ``greedy_find_bin``: equal-count greedy bin boundaries over sampled distinct
+  values (reference ``GreedyFindBin``, bin.cpp:78-155).
+- ``find_bin_with_zero_as_one_bin``: dedicated zero bin straddling
+  ±kZeroThreshold (reference ``FindBinWithZeroAsOneBin``, bin.cpp:256-314).
+- Missing handling ``MissingType {None, Zero, NaN}`` (reference bin.h:26): with
+  NaN present and ``use_missing``, the LAST bin is the NaN bin
+  (bin.cpp:398-402); with ``zero_as_missing`` the zero/default bin doubles as
+  the missing bin.
+- Categorical: categories sorted by count descending, bin 0 reserved for
+  NaN/other (reference bin.cpp:424-490).
+
+Unlike the reference we do NOT elide the most-frequent bin from histogram
+storage (``most_freq_bin`` offset machinery, bin.cpp:497-516 + FixHistogram):
+the TPU layout keeps dense ``[num_bins]`` histograms per feature, so
+``FixHistogram`` reconstruction is unnecessary. ``most_freq_bin_`` is still
+computed for sparsity bookkeeping.
+
+Binning the full data matrix is vectorized with ``np.searchsorted`` per
+feature (the analog of the per-value binary search ``BinMapper::ValueToBin``,
+bin.h:464-502).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .utils import log
+
+# reference: include/LightGBM/bin.h:30 (kZeroThreshold = 1e-35)
+K_ZERO_THRESHOLD = 1e-35
+# reference: include/LightGBM/bin.h:39 (kSparseThreshold = 0.7)
+K_SPARSE_THRESHOLD = 0.7
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+BIN_TYPE_NUMERICAL = 0
+BIN_TYPE_CATEGORICAL = 1
+
+
+def _get_double_upper_bound(a: float) -> float:
+    """Smallest double strictly greater than a (reference: common.h:830)."""
+    return float(np.nextafter(a, np.inf))
+
+
+def _check_double_equal_ordered(a: float, b: float) -> bool:
+    """reference: common.h:825 CheckDoubleEqualOrdered."""
+    upper = _get_double_upper_bound(a)
+    return a >= b or b <= upper
+
+
+def need_filter(cnt_in_bin: np.ndarray, total_cnt: int, filter_cnt: int,
+                bin_type: int) -> bool:
+    """Pre-filter: no threshold leaves >= filter_cnt on both sides
+    (reference: bin.cpp:54-76 NeedFilter)."""
+    if bin_type == BIN_TYPE_NUMERICAL:
+        sum_left = 0
+        for i in range(len(cnt_in_bin) - 1):
+            sum_left += int(cnt_in_bin[i])
+            if sum_left >= filter_cnt and total_cnt - sum_left >= filter_cnt:
+                return False
+    else:
+        if len(cnt_in_bin) <= 2:
+            for i in range(len(cnt_in_bin) - 1):
+                sum_left = int(cnt_in_bin[i])
+                if sum_left >= filter_cnt and total_cnt - sum_left >= filter_cnt:
+                    return False
+        else:
+            return False
+    return True
+
+
+def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray, max_bin: int,
+                    total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Equal-count greedy bin upper bounds (reference: bin.cpp:78-155 GreedyFindBin)."""
+    num_distinct = len(distinct_values)
+    bin_upper_bound: List[float] = []
+    assert max_bin > 0
+    if num_distinct <= max_bin:
+        cur_cnt_inbin = 0
+        for i in range(num_distinct - 1):
+            cur_cnt_inbin += counts[i]
+            if cur_cnt_inbin >= min_data_in_bin:
+                val = _get_double_upper_bound((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                if not bin_upper_bound or not _check_double_equal_ordered(bin_upper_bound[-1], val):
+                    bin_upper_bound.append(val)
+                    cur_cnt_inbin = 0
+        bin_upper_bound.append(math.inf)
+        return bin_upper_bound
+
+    if min_data_in_bin > 0:
+        max_bin = min(max_bin, total_cnt // min_data_in_bin)
+        max_bin = max(max_bin, 1)
+    mean_bin_size = total_cnt / max_bin
+
+    rest_bin_cnt = max_bin
+    rest_sample_cnt = int(total_cnt)
+    is_big_count_value = counts >= mean_bin_size
+    rest_bin_cnt -= int(is_big_count_value.sum())
+    rest_sample_cnt -= int(counts[is_big_count_value].sum())
+    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+
+    upper_bounds = [math.inf] * max_bin
+    lower_bounds = [math.inf] * max_bin
+    bin_cnt = 0
+    lower_bounds[0] = float(distinct_values[0])
+    cur_cnt_inbin = 0
+    for i in range(num_distinct - 1):
+        if not is_big_count_value[i]:
+            rest_sample_cnt -= counts[i]
+        cur_cnt_inbin += counts[i]
+        if (is_big_count_value[i] or cur_cnt_inbin >= mean_bin_size or
+                (is_big_count_value[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))):
+            upper_bounds[bin_cnt] = float(distinct_values[i])
+            bin_cnt += 1
+            lower_bounds[bin_cnt] = float(distinct_values[i + 1])
+            if bin_cnt >= max_bin - 1:
+                break
+            cur_cnt_inbin = 0
+            if not is_big_count_value[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    bin_cnt += 1
+    for i in range(bin_cnt - 1):
+        val = _get_double_upper_bound((upper_bounds[i] + lower_bounds[i + 1]) / 2.0)
+        if not bin_upper_bound or not _check_double_equal_ordered(bin_upper_bound[-1], val):
+            bin_upper_bound.append(val)
+    bin_upper_bound.append(math.inf)
+    return bin_upper_bound
+
+
+def find_bin_with_zero_as_one_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                                  max_bin: int, total_sample_cnt: int,
+                                  min_data_in_bin: int,
+                                  forced_bounds: Optional[Sequence[float]] = None) -> List[float]:
+    """Bin bounds with a dedicated zero bin (reference: bin.cpp:256-314)."""
+    if forced_bounds:
+        return _find_bin_with_predefined(distinct_values, counts, max_bin,
+                                         total_sample_cnt, min_data_in_bin,
+                                         list(forced_bounds))
+    left_mask = distinct_values <= -K_ZERO_THRESHOLD
+    right_mask = distinct_values > K_ZERO_THRESHOLD
+    left_cnt_data = int(counts[left_mask].sum())
+    cnt_zero = int(counts[~left_mask & ~right_mask].sum())
+    right_cnt_data = int(counts[right_mask].sum())
+
+    nz = np.nonzero(distinct_values > -K_ZERO_THRESHOLD)[0]
+    left_cnt = int(nz[0]) if len(nz) else len(distinct_values)
+
+    bin_upper_bound: List[float] = []
+    if left_cnt > 0 and max_bin > 1:
+        denom = max(total_sample_cnt - cnt_zero, 1)
+        left_max_bin = max(1, int(left_cnt_data / denom * (max_bin - 1)))
+        bin_upper_bound = greedy_find_bin(distinct_values[:left_cnt], counts[:left_cnt],
+                                          left_max_bin, left_cnt_data, min_data_in_bin)
+        if bin_upper_bound:
+            bin_upper_bound[-1] = -K_ZERO_THRESHOLD
+
+    nz = np.nonzero(distinct_values[left_cnt:] > K_ZERO_THRESHOLD)[0]
+    right_start = (left_cnt + int(nz[0])) if len(nz) else -1
+
+    right_max_bin = max_bin - 1 - len(bin_upper_bound)
+    if right_start >= 0 and right_max_bin > 0:
+        right_bounds = greedy_find_bin(distinct_values[right_start:], counts[right_start:],
+                                       right_max_bin, right_cnt_data, min_data_in_bin)
+        bin_upper_bound.append(K_ZERO_THRESHOLD)
+        bin_upper_bound.extend(right_bounds)
+    else:
+        bin_upper_bound.append(math.inf)
+    assert len(bin_upper_bound) <= max_bin
+    return bin_upper_bound
+
+
+def _find_bin_with_predefined(distinct_values: np.ndarray, counts: np.ndarray,
+                              max_bin: int, total_sample_cnt: int,
+                              min_data_in_bin: int,
+                              forced_bounds: List[float]) -> List[float]:
+    """Forced bin bounds + greedy fill of the remainder
+    (reference: bin.cpp:157-254 FindBinWithPredefinedBin, simplified: forced
+    bounds become fixed boundaries, remaining budget binned greedily)."""
+    forced = sorted(set(forced_bounds))
+    bounds = [float(b) for b in forced if np.isfinite(b)]
+    remaining = max_bin - 1 - len(bounds)
+    if remaining > 0:
+        auto = find_bin_with_zero_as_one_bin(distinct_values, counts, remaining + 1,
+                                             total_sample_cnt, min_data_in_bin)
+        bounds.extend(b for b in auto if np.isfinite(b))
+    bounds = sorted(set(bounds))[:max_bin - 1]
+    bounds.append(math.inf)
+    return bounds
+
+
+class BinMapper:
+    """Per-feature value→bin mapping (reference: include/LightGBM/bin.h:61-225)."""
+
+    def __init__(self):
+        self.num_bin: int = 1
+        self.missing_type: int = MISSING_NONE
+        self.bin_type: int = BIN_TYPE_NUMERICAL
+        self.is_trivial: bool = True
+        self.sparse_rate: float = 1.0
+        self.bin_upper_bound: np.ndarray = np.array([math.inf])
+        self.bin_2_categorical: List[int] = []
+        self.categorical_2_bin: Dict[int, int] = {}
+        self.default_bin: int = 0       # bin of value 0 (bin.h GetDefaultBin)
+        self.most_freq_bin: int = 0
+        self.min_val: float = 0.0
+        self.max_val: float = 0.0
+
+    # ------------------------------------------------------------------ fit
+    def find_bin(self, values: np.ndarray, total_sample_cnt: int, max_bin: int,
+                 min_data_in_bin: int = 3, min_split_data: int = 0,
+                 pre_filter: bool = False, bin_type: int = BIN_TYPE_NUMERICAL,
+                 use_missing: bool = True, zero_as_missing: bool = False,
+                 forced_bounds: Optional[Sequence[float]] = None) -> None:
+        """Fit the mapper on sampled values (reference: bin.cpp:325-520 FindBin).
+
+        ``values`` are the sampled non-zero entries; ``total_sample_cnt`` is the
+        number of sampled rows (zeros implied by the difference, matching the
+        reference's sparse sampling protocol, dataset_loader.cpp:953+).
+        """
+        values = np.asarray(values, dtype=np.float64)
+        na_mask = np.isnan(values)
+        na_cnt = int(na_mask.sum())
+        values = values[~na_mask]
+
+        if not use_missing:
+            self.missing_type = MISSING_NONE
+        elif zero_as_missing:
+            self.missing_type = MISSING_ZERO
+        else:
+            self.missing_type = MISSING_NAN if na_cnt > 0 else MISSING_NONE
+
+        self.bin_type = bin_type
+        self.default_bin = 0
+        zero_cnt = int(total_sample_cnt - len(values) - na_cnt)
+
+        # distinct values with counts; zero slot positioned in sorted order
+        # (reference: bin.cpp:355-395)
+        if len(values):
+            vals, counts = np.unique(values, return_counts=True)
+        else:
+            vals, counts = np.array([]), np.array([], dtype=np.int64)
+        if zero_cnt > 0 or len(vals) == 0:
+            if 0.0 not in vals:
+                insert_at = int(np.searchsorted(vals, 0.0))
+                vals = np.insert(vals, insert_at, 0.0)
+                counts = np.insert(counts, insert_at, zero_cnt)
+            else:
+                counts[np.searchsorted(vals, 0.0)] += zero_cnt
+        self.min_val = float(vals[0]) if len(vals) else 0.0
+        self.max_val = float(vals[-1]) if len(vals) else 0.0
+        counts = counts.astype(np.int64)
+
+        cnt_in_bin: np.ndarray
+        if bin_type == BIN_TYPE_NUMERICAL:
+            if self.missing_type in (MISSING_ZERO, MISSING_NONE):
+                bounds = find_bin_with_zero_as_one_bin(vals, counts, max_bin,
+                                                       total_sample_cnt, min_data_in_bin,
+                                                       forced_bounds)
+                if self.missing_type == MISSING_ZERO and len(bounds) == 2:
+                    self.missing_type = MISSING_NONE
+            else:  # NaN bin appended as the last bin (bin.cpp:398-402)
+                bounds = find_bin_with_zero_as_one_bin(vals, counts, max_bin - 1,
+                                                       total_sample_cnt - na_cnt,
+                                                       min_data_in_bin, forced_bounds)
+                bounds.append(math.nan)
+            self.bin_upper_bound = np.asarray(bounds)
+            self.num_bin = len(bounds)
+            # count per bin (bin.cpp:404-421)
+            n_real = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+            finite_bounds = self.bin_upper_bound[:n_real]
+            cnt_in_bin = np.zeros(self.num_bin, dtype=np.int64)
+            if len(vals):
+                idx = np.searchsorted(finite_bounds, vals, side="left")
+                # value goes to first bin whose upper bound >= value
+                np.add.at(cnt_in_bin, np.minimum(idx, n_real - 1), counts)
+            if self.missing_type == MISSING_NAN:
+                cnt_in_bin[self.num_bin - 1] = na_cnt
+        else:
+            # categorical (reference: bin.cpp:424-490)
+            vals_int = vals.astype(np.int64)
+            neg = vals_int < 0
+            if neg.any():
+                log.warning("Met negative value in categorical features, will convert it to NaN")
+                na_cnt += int(counts[neg].sum())
+                vals_int, counts = vals_int[~neg], counts[~neg]
+            # merge duplicates after int cast
+            if len(vals_int):
+                vals_int_u, inv = np.unique(vals_int, return_inverse=True)
+                counts_u = np.zeros(len(vals_int_u), dtype=np.int64)
+                np.add.at(counts_u, inv, counts)
+            else:
+                vals_int_u, counts_u = vals_int, counts
+            rest_cnt = total_sample_cnt - na_cnt
+            self.bin_2_categorical = [-1]   # bin 0 = NaN/other bin
+            self.categorical_2_bin = {-1: 0}
+            cnt_list = [0]
+            self.num_bin = 1
+            if rest_cnt > 0 and len(vals_int_u):
+                order = np.argsort(-counts_u, kind="stable")
+                cut_cnt = int(round((total_sample_cnt - na_cnt) * 0.99))
+                distinct_cnt = len(vals_int_u) + (1 if na_cnt > 0 else 0)
+                eff_max_bin = min(distinct_cnt, max_bin)
+                used_cnt = 0
+                for rank, j in enumerate(order):
+                    if not (used_cnt < cut_cnt or self.num_bin < eff_max_bin):
+                        break
+                    if counts_u[j] < min_data_in_bin and rank > 1:
+                        break
+                    cat = int(vals_int_u[j])
+                    self.bin_2_categorical.append(cat)
+                    self.categorical_2_bin[cat] = self.num_bin
+                    used_cnt += int(counts_u[j])
+                    cnt_list.append(int(counts_u[j]))
+                    self.num_bin += 1
+                all_used = (self.num_bin - 1) == len(vals_int_u)
+                self.missing_type = MISSING_NONE if (all_used and na_cnt == 0) else MISSING_NAN
+                cnt_list[0] = int(total_sample_cnt - used_cnt)
+            cnt_in_bin = np.asarray(cnt_list, dtype=np.int64)
+
+        # trivial / pre-filter (bin.cpp:494-503)
+        self.is_trivial = self.num_bin <= 1
+        if (not self.is_trivial and pre_filter
+                and need_filter(cnt_in_bin, int(total_sample_cnt),
+                                int(min_split_data), bin_type)):
+            self.is_trivial = True
+        if not self.is_trivial:
+            self.default_bin = self.value_to_bin(0.0)
+            self.most_freq_bin = int(np.argmax(cnt_in_bin))
+            max_sparse_rate = float(cnt_in_bin[self.most_freq_bin]) / max(total_sample_cnt, 1)
+            if self.most_freq_bin != self.default_bin and max_sparse_rate < K_SPARSE_THRESHOLD:
+                self.most_freq_bin = self.default_bin
+            self.sparse_rate = float(cnt_in_bin[self.most_freq_bin]) / max(total_sample_cnt, 1)
+        else:
+            self.sparse_rate = 1.0
+
+    # ---------------------------------------------------------------- apply
+    def value_to_bin(self, value: float) -> int:
+        """Scalar value→bin (reference: bin.h:464-502 ValueToBin)."""
+        return int(self.values_to_bins(np.array([value]))[0])
+
+    def values_to_bins(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value→bin for a whole column."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BIN_TYPE_CATEGORICAL:
+            out = np.zeros(len(values), dtype=np.int32)
+            if self.categorical_2_bin:
+                cats = np.array(self.bin_2_categorical[1:], dtype=np.int64)
+                bins = np.arange(1, self.num_bin, dtype=np.int32)
+                vals_int = np.where(np.isnan(values), -1, values).astype(np.int64)
+                if len(cats):
+                    sorter = np.argsort(cats)
+                    pos = np.searchsorted(cats[sorter], vals_int)
+                    pos = np.clip(pos, 0, len(cats) - 1)
+                    matched = cats[sorter][pos] == vals_int
+                    out = np.where(matched, bins[sorter][pos], 0).astype(np.int32)
+            return out
+        has_nan_bin = self.missing_type == MISSING_NAN
+        n_real = self.num_bin - (1 if has_nan_bin else 0)
+        finite_bounds = self.bin_upper_bound[:n_real - 1] if n_real > 0 else np.array([])
+        vals = values
+        if self.missing_type == MISSING_ZERO:
+            # NaN treated as zero → default bin (bin.h:479-481)
+            vals = np.where(np.isnan(vals), 0.0, vals)
+        idx = np.searchsorted(finite_bounds, vals, side="left").astype(np.int32)
+        # value == bound goes to that bin (upper bounds inclusive): searchsorted
+        # 'left' puts v==bound at the bound's bin, matching `value <= upper`.
+        if has_nan_bin:
+            idx = np.where(np.isnan(values), self.num_bin - 1, idx).astype(np.int32)
+        return idx
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Representative threshold value for a bin boundary (used for real-valued
+        tree thresholds, reference: tree.h RealThreshold)."""
+        if self.bin_type == BIN_TYPE_CATEGORICAL:
+            return float(self.bin_2_categorical[bin_idx]) if bin_idx < len(self.bin_2_categorical) else -1.0
+        return float(self.bin_upper_bound[bin_idx])
+
+    # ---------------------------------------------------------- serialization
+    def to_dict(self) -> dict:
+        return {
+            "num_bin": self.num_bin,
+            "missing_type": self.missing_type,
+            "bin_type": self.bin_type,
+            "is_trivial": self.is_trivial,
+            "sparse_rate": self.sparse_rate,
+            "bin_upper_bound": [float(x) for x in self.bin_upper_bound],
+            "bin_2_categorical": list(self.bin_2_categorical),
+            "default_bin": self.default_bin,
+            "most_freq_bin": self.most_freq_bin,
+            "min_val": self.min_val,
+            "max_val": self.max_val,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BinMapper":
+        m = cls()
+        m.num_bin = int(d["num_bin"])
+        m.missing_type = int(d["missing_type"])
+        m.bin_type = int(d["bin_type"])
+        m.is_trivial = bool(d["is_trivial"])
+        m.sparse_rate = float(d["sparse_rate"])
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_2_categorical = [int(x) for x in d["bin_2_categorical"]]
+        m.categorical_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
+        m.default_bin = int(d["default_bin"])
+        m.most_freq_bin = int(d["most_freq_bin"])
+        m.min_val = float(d.get("min_val", 0.0))
+        m.max_val = float(d.get("max_val", 0.0))
+        return m
+
+
+def sample_indices(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
+    """Row sample for bin finding (reference: dataset_loader.cpp sampling with
+    Random::Sample; here a seeded choice without replacement)."""
+    if num_data <= sample_cnt:
+        return np.arange(num_data)
+    rng = np.random.RandomState(seed)
+    return np.sort(rng.choice(num_data, size=sample_cnt, replace=False))
+
+
+def find_bin_mappers(X: np.ndarray, config, categorical_features: Sequence[int] = (),
+                     forced_bounds: Optional[Dict[int, List[float]]] = None) -> List[BinMapper]:
+    """Fit one BinMapper per column (reference: DatasetLoader::
+    ConstructBinMappersFromTextData, dataset_loader.cpp:953-1140)."""
+    num_data, num_features = X.shape
+    sample_idx = sample_indices(num_data, config.bin_construct_sample_cnt,
+                                config.data_random_seed)
+    cat_set = set(int(c) for c in categorical_features)
+    forced_bounds = forced_bounds or {}
+    mappers = []
+    max_bin_by_feature = config.max_bin_by_feature
+    # reference: dataset_loader.cpp:647-648 filter_cnt scaling
+    filter_cnt = int(config.min_data_in_leaf * len(sample_idx) / max(num_data, 1))
+    for j in range(num_features):
+        col = np.asarray(X[sample_idx, j], dtype=np.float64)
+        m = BinMapper()
+        max_bin = (max_bin_by_feature[j] if j < len(max_bin_by_feature)
+                   else config.max_bin)
+        m.find_bin(
+            col, total_sample_cnt=len(sample_idx), max_bin=max_bin,
+            min_data_in_bin=config.min_data_in_bin,
+            min_split_data=filter_cnt,
+            pre_filter=config.feature_pre_filter,
+            bin_type=BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL,
+            use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing,
+            forced_bounds=forced_bounds.get(j),
+        )
+        mappers.append(m)
+    return mappers
+
+
+def bin_data(X: np.ndarray, mappers: Sequence[BinMapper]) -> np.ndarray:
+    """Quantize the full matrix → int32 bin matrix [num_data, num_features]."""
+    num_data, num_features = X.shape
+    out = np.zeros((num_data, num_features), dtype=np.int32)
+    for j, m in enumerate(mappers):
+        if m.is_trivial:
+            continue
+        out[:, j] = m.values_to_bins(np.asarray(X[:, j], dtype=np.float64))
+    return out

@@ -1,0 +1,155 @@
+"""Booster: the user-facing trained-model handle.
+
+Mirrors the reference Python ``Booster`` (reference:
+python-package/lightgbm/basic.py Booster) over the boosting layer, playing
+the role of the C API's Booster wrapper (reference: src/c_api.cpp:52-106) —
+here there is no C boundary; the boosting object is held directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Dataset
+from .config import Config
+from .models.boosting import create_boosting
+from .utils import log
+
+
+class Booster:
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params or {})
+        self.config = Config.from_params(self.params)
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_set = train_set
+        if model_file is not None or model_str is not None:
+            from .io.model_text import load_model
+            if model_file is not None:
+                with open(model_file) as fh:
+                    model_str = fh.read()
+            self._boosting = load_model(model_str, self.config)
+        elif train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be Dataset instance")
+            # merge dataset params before construction
+            merged = dict(train_set.params or {})
+            merged.update(self.params)
+            train_set.params = merged
+            self._boosting = create_boosting(self.config, train_set)
+        else:
+            raise ValueError("need at least one of train_set, model_file or model_str")
+
+    # ------------------------------------------------------------ training
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        self._boosting.add_valid(data, name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; with ``fobj`` the gradients come from
+        Python (reference: basic.py Booster.update + c_api.cpp:1645
+        LGBM_BoosterUpdateOneIterCustom)."""
+        if train_set is not None and train_set is not self._train_set:
+            log.fatal("Replacing the training set in update() is not supported")
+        if fobj is None:
+            return self._boosting.train_one_iter()
+        grad, hess = fobj(np.asarray(self._boosting.train_score, dtype=np.float64),
+                          self._train_set)
+        return self._boosting.train_one_iter(grad, hess)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._boosting.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return self._boosting.current_iteration()
+
+    def num_trees(self) -> int:
+        return self._boosting.num_trees
+
+    def num_model_per_iteration(self) -> int:
+        return self._boosting.num_tree_per_iteration
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """reference: basic.py Booster.reset_parameter (learning_rate etc.)."""
+        self.params.update(params)
+        self.config = Config.from_params(self.params)
+        self._boosting.reset_config(self.config)
+        return self
+
+    # ---------------------------------------------------------------- eval
+    def eval_set(self, feval=None):
+        return self._boosting.eval_set(feval)
+
+    def eval_train(self, feval=None):
+        old = self.config.is_provide_training_metric
+        self.config.is_provide_training_metric = True
+        try:
+            return [r for r in self._boosting.eval_set(feval) if r[0] == "training"]
+        finally:
+            self.config.is_provide_training_metric = old
+
+    def eval_valid(self, feval=None):
+        return [r for r in self._boosting.eval_set(feval) if r[0] != "training"]
+
+    # ------------------------------------------------------------- predict
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs) -> np.ndarray:
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        if pred_leaf:
+            return self._boosting.predict_leaf(data, num_iteration)
+        if pred_contrib:
+            return self._boosting.predict_contrib(data, num_iteration)
+        return self._boosting.predict(data, raw_score=raw_score,
+                                      num_iteration=num_iteration,
+                                      start_iteration=start_iteration)
+
+    # ------------------------------------------------------------ model IO
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        from .io.model_text import dump_model_text
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 else -1
+        return dump_model_text(self._boosting, num_iteration, start_iteration)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> dict:
+        from .io.model_text import dump_model_json
+        return dump_model_json(self._boosting, num_iteration or -1, start_iteration)
+
+    # ------------------------------------------------------ importance etc
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        """reference: gbdt.cpp FeatureImportance (split counts / total gains)."""
+        num_features = self._boosting.train_set.num_total_features
+        imp = np.zeros(num_features, dtype=np.float64)
+        for ht in self._boosting.host_trees:
+            for i in range(ht.num_leaves - 1):
+                real_feat = int(ht.feature_indices[ht.split_feature[i]])
+                if importance_type == "split":
+                    imp[real_feat] += 1.0
+                else:
+                    imp[real_feat] += max(float(ht.split_gain[i]), 0.0)
+        if importance_type == "split":
+            return imp.astype(np.int32)
+        return imp
+
+    def feature_name(self) -> List[str]:
+        return self._boosting.train_set.get_feature_names()
+
+    def num_feature(self) -> int:
+        return self._boosting.train_set.num_total_features

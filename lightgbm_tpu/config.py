@@ -1,0 +1,406 @@
+"""Configuration / parameter system.
+
+TPU-native re-design of the reference's config layer
+(reference: include/LightGBM/config.h:34, src/io/config.cpp, src/io/config_auto.cpp).
+A single dataclass holds every supported parameter with its reference default;
+``Config.from_params`` resolves aliases centrally the way ``ParameterAlias::
+KeyAliasTransform`` does (reference: src/io/config.cpp, config_auto.cpp:12-168) and
+the Python-side ``_ConfigAliases`` table (reference: python-package/lightgbm/basic.py:273).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from .utils import log
+
+# Alias -> canonical name (reference: src/io/config_auto.cpp:12-168).
+PARAM_ALIASES: Dict[str, str] = {
+    "config_file": "config",
+    "task_type": "task",
+    "objective_type": "objective", "app": "objective", "application": "objective",
+    "boosting_type": "boosting", "boost": "boosting",
+    "linear_trees": "linear_tree",
+    "train": "data", "train_data": "data", "train_data_file": "data", "data_filename": "data",
+    "test": "valid", "valid_data": "valid", "valid_data_file": "valid",
+    "test_data": "valid", "test_data_file": "valid", "valid_filenames": "valid",
+    "num_iteration": "num_iterations", "n_iter": "num_iterations",
+    "num_tree": "num_iterations", "num_trees": "num_iterations",
+    "num_round": "num_iterations", "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations", "n_estimators": "num_iterations",
+    "shrinkage_rate": "learning_rate", "eta": "learning_rate",
+    "num_leaf": "num_leaves", "max_leaves": "num_leaves", "max_leaf": "num_leaves",
+    "tree": "tree_learner", "tree_type": "tree_learner", "tree_learner_type": "tree_learner",
+    "num_thread": "num_threads", "nthread": "num_threads", "nthreads": "num_threads",
+    "n_jobs": "num_threads",
+    "device": "device_type",
+    "random_seed": "seed", "random_state": "seed",
+    "hist_pool_size": "histogram_pool_size",
+    "min_data_per_leaf": "min_data_in_leaf", "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf", "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "sub_row": "bagging_fraction", "subsample": "bagging_fraction", "bagging": "bagging_fraction",
+    "pos_sub_row": "pos_bagging_fraction", "pos_subsample": "pos_bagging_fraction",
+    "pos_bagging": "pos_bagging_fraction",
+    "neg_sub_row": "neg_bagging_fraction", "neg_subsample": "neg_bagging_fraction",
+    "neg_bagging": "neg_bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "bagging_fraction_seed": "bagging_seed",
+    "sub_feature": "feature_fraction", "colsample_bytree": "feature_fraction",
+    "sub_feature_bynode": "feature_fraction_bynode", "colsample_bynode": "feature_fraction_bynode",
+    "extra_tree": "extra_trees",
+    "early_stopping_rounds": "early_stopping_round", "early_stopping": "early_stopping_round",
+    "n_iter_no_change": "early_stopping_round",
+    "max_tree_output": "max_delta_step", "max_leaf_output": "max_delta_step",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2", "lambda": "lambda_l2",
+    "min_split_gain": "min_gain_to_split",
+    "rate_drop": "drop_rate",
+    "topk": "top_k",
+    "mc": "monotone_constraints", "monotone_constraint": "monotone_constraints",
+    "monotone_constraining_method": "monotone_constraints_method",
+    "mc_method": "monotone_constraints_method",
+    "monotone_splits_penalty": "monotone_penalty", "ms_penalty": "monotone_penalty",
+    "mc_penalty": "monotone_penalty",
+    "feature_contrib": "feature_contri", "fc": "feature_contri", "fp": "feature_contri",
+    "feature_penalty": "feature_contri",
+    "fs": "forcedsplits_filename", "forced_splits_filename": "forcedsplits_filename",
+    "forced_splits_file": "forcedsplits_filename", "forced_splits": "forcedsplits_filename",
+    "verbose": "verbosity",
+    "model_input": "input_model", "model_in": "input_model",
+    "model_output": "output_model", "model_out": "output_model",
+    "save_period": "snapshot_freq",
+    "subsample_for_bin": "bin_construct_sample_cnt",
+    "data_seed": "data_random_seed",
+    "is_sparse": "is_enable_sparse", "enable_sparse": "is_enable_sparse", "sparse": "is_enable_sparse",
+    "is_enable_bundle": "enable_bundle", "bundle": "enable_bundle",
+    "is_pre_partition": "pre_partition",
+    "two_round_loading": "two_round", "use_two_round_loading": "two_round",
+    "has_header": "header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column", "group_id": "group_column", "query_column": "group_column",
+    "query": "group_column", "query_id": "group_column",
+    "ignore_feature": "ignore_column", "blacklist": "ignore_column",
+    "cat_feature": "categorical_feature", "categorical_column": "categorical_feature",
+    "cat_column": "categorical_feature",
+    "is_save_binary": "save_binary", "is_save_binary_file": "save_binary",
+    "is_predict_raw_score": "predict_raw_score", "predict_rawscore": "predict_raw_score",
+    "raw_score": "predict_raw_score",
+    "is_predict_leaf_index": "predict_leaf_index", "leaf_index": "predict_leaf_index",
+    "is_predict_contrib": "predict_contrib", "contrib": "predict_contrib",
+    "predict_result": "output_result", "prediction_result": "output_result",
+    "predict_name": "output_result", "prediction_name": "output_result",
+    "pred_name": "output_result", "name_pred": "output_result",
+    "convert_model_file": "convert_model",
+    "num_classes": "num_class",
+    "unbalance": "is_unbalance", "unbalanced_sets": "is_unbalance",
+    "metrics": "metric", "metric_types": "metric",
+    "output_freq": "metric_freq",
+    "training_metric": "is_provide_training_metric",
+    "is_training_metric": "is_provide_training_metric",
+    "train_metric": "is_provide_training_metric",
+    "ndcg_eval_at": "eval_at", "ndcg_at": "eval_at", "map_eval_at": "eval_at", "map_at": "eval_at",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port", "port": "local_listen_port",
+    "machine_list_file": "machine_list_filename", "machine_list": "machine_list_filename",
+    "mlist": "machine_list_filename",
+    "workers": "machines", "nodes": "machines",
+}
+
+# Objective aliases (reference: src/objective/objective_function.cpp + config.cpp ParseObjectiveAlias)
+_OBJECTIVE_ALIASES = {
+    "regression_l2": "regression", "mean_squared_error": "regression", "mse": "regression",
+    "l2": "regression", "l2_root": "regression", "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "regression_l1": "regression_l1", "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_percentage_error": "mape",
+    "lambdarank": "lambdarank", "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg", "xe_ndcg_mart": "rank_xendcg",
+    "xendcg_mart": "rank_xendcg",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "xentropy": "cross_entropy", "xentlambda": "cross_entropy_lambda",
+    "mean_squared_logarithmic_error": "regression",
+}
+
+_METRIC_ALIASES = {
+    "l2_root": "rmse", "root_mean_squared_error": "rmse",
+    "mean_squared_error": "l2", "mse": "l2", "regression_l2": "l2", "regression": "l2",
+    "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "mean_absolute_percentage_error": "mape",
+    "binary_logloss": "binary_logloss",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg", "xendcg": "ndcg",
+    "xe_ndcg": "ndcg", "xe_ndcg_mart": "ndcg", "xendcg_mart": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss", "ova": "multi_logloss", "ovr": "multi_logloss",
+    "xentropy": "cross_entropy", "xentlambda": "cross_entropy_lambda",
+    "kldiv": "kullback_leibler",
+}
+
+
+@dataclass
+class Config:
+    """All supported parameters, defaults matching the reference (config.h:34-1197)."""
+
+    # Core (config.h:97-233)
+    task: str = "train"
+    objective: str = "regression"
+    boosting: str = "gbdt"
+    data: str = ""
+    valid: List[str] = field(default_factory=list)
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    tree_learner: str = "serial"
+    num_threads: int = 0
+    device_type: str = "tpu"   # reference default "cpu" (config.h:225); TPU-native here
+    seed: Optional[int] = None
+    deterministic: bool = False
+
+    # Learning control (config.h:237-600)
+    force_col_wise: bool = False
+    force_row_wise: bool = False
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    extra_trees: bool = False
+    extra_seed: int = 6
+    early_stopping_round: int = 0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    linear_lambda: float = 0.0
+    min_gain_to_split: float = 0.0
+    drop_rate: float = 0.1          # DART
+    max_drop: int = 50              # DART
+    skip_drop: float = 0.5          # DART
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2           # GOSS
+    other_rate: float = 0.1         # GOSS
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20                 # voting parallel
+    monotone_constraints: List[int] = field(default_factory=list)
+    monotone_constraints_method: str = "basic"
+    monotone_penalty: float = 0.0
+    feature_contri: List[float] = field(default_factory=list)
+    forcedsplits_filename: str = ""
+    refit_decay_rate: float = 0.9
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_penalty_feature_lazy: List[float] = field(default_factory=list)
+    cegb_penalty_feature_coupled: List[float] = field(default_factory=list)
+    path_smooth: float = 0.0
+    interaction_constraints: List[List[int]] = field(default_factory=list)
+    verbosity: int = 1
+    snapshot_freq: int = -1
+    linear_tree: bool = False
+
+    # IO / dataset (config.h:604-800)
+    max_bin: int = 255
+    max_bin_by_feature: List[int] = field(default_factory=list)
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    is_enable_sparse: bool = True
+    enable_bundle: bool = True
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    feature_pre_filter: bool = True
+    pre_partition: bool = False
+    two_round: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: Union[str, List[int]] = ""
+    forcedbins_filename: str = ""
+    save_binary: bool = False
+    precise_float_parser: bool = False
+
+    # Predict (config.h:804-900)
+    start_iteration_predict: int = 0
+    num_iteration_predict: int = -1
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    predict_disable_shape_check: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    output_result: str = "LightGBM_predict_result.txt"
+
+    # Convert / model files
+    convert_model_language: str = ""
+    convert_model: str = "gbdt_prediction.cpp"
+    input_model: str = ""
+    output_model: str = "LightGBM_model.txt"
+
+    # Objective (config.h:904-970)
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9              # Huber / Quantile
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    lambdarank_truncation_level: int = 30
+    lambdarank_norm: bool = True
+    label_gain: List[float] = field(default_factory=list)
+
+    # Metric (config.h:1000-1060)
+    metric: List[str] = field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+    multi_error_top_k: int = 1
+    auc_mu_weights: List[float] = field(default_factory=list)
+
+    # Network / distributed (config.h:974-995). On TPU these select the device
+    # mesh rather than a socket/MPI rank list (SURVEY.md §2.6 TPU-native note).
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # GPU analog: TPU controls
+    gpu_use_dp: bool = False        # if True use float64-grade (compensated) histograms
+    num_gpu: int = 1
+
+    # TPU-specific (new; no reference analog)
+    mesh_shape: Optional[Dict[str, int]] = None     # e.g. {"data": 8}
+    hist_block_rows: int = 16384                    # row-block for histogram matmul
+    # "batched": all available splits per histogram round (fast, see
+    # models/grower.py docstring); "exact": strict best-first like the
+    # reference's leaf-wise order (one histogram round per split).
+    tree_growth_mode: str = "batched"
+    histogram_method: str = "auto"                  # auto|scatter|binloop
+
+    def __post_init__(self):
+        if self.seed is not None:
+            # seed derives the sub-seeds exactly like config.cpp:150-161
+            self.data_random_seed = self.seed + 1
+            self.bagging_seed = self.seed + 3
+            self.drop_seed = self.seed + 4
+            self.feature_fraction_seed = self.seed + 2
+            self.extra_seed = self.seed + 6
+
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]] = None, **kwargs) -> "Config":
+        params = dict(params or {})
+        params.update(kwargs)
+        resolved: Dict[str, Any] = {}
+        fields = {f.name for f in dataclasses.fields(cls)}
+        for key, value in params.items():
+            canonical = PARAM_ALIASES.get(key, key)
+            if canonical in resolved and key != canonical:
+                continue  # explicit canonical name wins over alias (config.cpp KV2Map)
+            if canonical not in fields:
+                log.warning(f"Unknown parameter: {key}")
+                continue
+            resolved[canonical] = value
+        cfg = cls()
+        for key, value in resolved.items():
+            setattr(cfg, key, _coerce(cfg, key, value))
+        cfg.objective = _OBJECTIVE_ALIASES.get(cfg.objective, cfg.objective)
+        cfg.metric = [_METRIC_ALIASES.get(m, m) for m in cfg.metric]
+        cfg._check()
+        return cfg
+
+    def _check(self) -> None:
+        # bounds checks mirroring config.h CHECK_ constraints
+        if self.num_leaves < 2:
+            log.fatal(f"num_leaves must be >= 2, got {self.num_leaves}")
+        if not (1 < self.max_bin <= 65535):
+            log.fatal(f"max_bin must be in (1, 65535], got {self.max_bin}")
+        if not (0.0 < self.bagging_fraction <= 1.0):
+            log.fatal("bagging_fraction should be in (0.0, 1.0]")
+        if not (0.0 < self.feature_fraction <= 1.0):
+            log.fatal("feature_fraction should be in (0.0, 1.0]")
+        if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
+            log.fatal("num_class must be >= 2 for multiclass objectives")
+        log.set_verbosity(self.verbosity)
+
+    def to_params(self) -> Dict[str, Any]:
+        """Canonical parameter dict (analog of Config::ToString, config_auto.cpp)."""
+        out = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v != f.default and not isinstance(f.default, dataclasses._MISSING_TYPE):
+                out[f.name] = v
+        return out
+
+
+def _coerce(cfg: Config, key: str, value: Any) -> Any:
+    """Coerce a string/user value to the field's declared type (Config::Set)."""
+    current = getattr(cfg, key)
+    ftype = type(current)
+    if value is None:
+        return current
+    if key == "metric":
+        if isinstance(value, str):
+            value = [v.strip() for v in value.split(",") if v.strip() and v.strip() != "None"]
+        elif isinstance(value, (list, tuple)):
+            value = list(value)
+        return value
+    if key in ("valid", "label_gain", "eval_at", "monotone_constraints", "feature_contri",
+               "max_bin_by_feature", "auc_mu_weights", "cegb_penalty_feature_lazy",
+               "cegb_penalty_feature_coupled"):
+        if isinstance(value, str):
+            parts = [v for v in value.split(",") if v]
+            elem = float if key in ("label_gain", "feature_contri", "auc_mu_weights",
+                                    "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled") else (
+                str if key == "valid" else int)
+            return [elem(v) for v in parts]
+        return list(value)
+    if isinstance(current, bool):
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "yes", "+")
+        return bool(value)
+    if isinstance(current, int) or (current is None and key == "seed"):
+        return int(value)
+    if isinstance(current, float):
+        return float(value)
+    return value
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Parse a CLI ``key = value`` config file (reference: application.cpp:52-85,
+    Config::KV2Map). Lines after '#' are comments."""
+    params: Dict[str, str] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            key, value = line.split("=", 1)
+            params[key.strip()] = value.strip()
+    return params

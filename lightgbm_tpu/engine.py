@@ -1,0 +1,246 @@
+"""Training entry points: train() and cv().
+
+Mirrors the reference's Python engine (reference:
+python-package/lightgbm/engine.py:14-470): parameter munging, the
+callbacks-before/after-iteration protocol, early stopping via
+``EarlyStopException`` (engine.py:244-272), and stratified/group-aware CV
+folds (engine.py:281-470).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Dataset
+from .booster import Booster
+from .callback import CallbackEnv, EarlyStopException
+from .config import PARAM_ALIASES
+from .utils import log
+
+
+def _resolve_num_boost_round(params: Dict[str, Any], num_boost_round: int) -> int:
+    for alias, canonical in PARAM_ALIASES.items():
+        if canonical == "num_iterations" and alias in params:
+            return int(params.pop(alias))
+    return int(params.pop("num_iterations", num_boost_round))
+
+
+def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj=None, feval=None, init_model=None,
+          feature_name="auto", categorical_feature="auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[dict] = None,
+          verbose_eval="warn", learning_rates=None,
+          keep_training_booster: bool = False, callbacks=None) -> Booster:
+    """Train a booster (reference: engine.py:14-278)."""
+    params = copy.deepcopy(params)
+    num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    if fobj is not None:
+        params["objective"] = "none"
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+
+    first_metric_only = params.get("first_metric_only", False)
+
+    if init_model is not None:
+        log.warning("init_model continued training is not yet implemented; starting fresh")
+
+    booster = Booster(params=params, train_set=train_set)
+    valid_sets = valid_sets or []
+    valid_names = valid_names or []
+    for i, vs in enumerate(valid_sets):
+        if vs is train_set:
+            booster._boosting.config.is_provide_training_metric = True
+            continue
+        name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+        if vs.reference is None:
+            vs.reference = train_set
+        booster.add_valid(vs, name)
+
+    cbs = set(callbacks or [])
+    if verbose_eval is True or (isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool)):
+        period = 1 if verbose_eval is True else verbose_eval
+        cbs.add(callback_mod.print_evaluation(period))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds, first_metric_only))
+    if evals_result is not None:
+        cbs.add(callback_mod.record_evaluation(evals_result))
+    if learning_rates is not None:
+        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+
+    cbs_before = sorted((c for c in cbs if getattr(c, "before_iteration", False)),
+                        key=lambda c: getattr(c, "order", 0))
+    cbs_after = sorted((c for c in cbs if not getattr(c, "before_iteration", False)),
+                       key=lambda c: getattr(c, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(CallbackEnv(model=booster, params=params, iteration=i,
+                           begin_iteration=0, end_iteration=num_boost_round,
+                           evaluation_result_list=None))
+        booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_sets or booster._boosting.config.is_provide_training_metric:
+            evaluation_result_list = booster.eval_set(feval)
+        try:
+            for cb in cbs_after:
+                cb(CallbackEnv(model=booster, params=params, iteration=i,
+                               begin_iteration=0, end_iteration=num_boost_round,
+                               evaluation_result_list=evaluation_result_list))
+        except EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            for item in es.best_score:
+                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+            break
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference: engine.py:281-317)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict[str, Any],
+                  seed: int, stratified: bool, shuffle: bool):
+    """reference: engine.py:319-376 _make_n_folds."""
+    full_data.construct()
+    num_data = full_data.num_data
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and hasattr(folds, "split"):
+            group = full_data.get_group()
+            if group is not None:
+                group_idx = np.repeat(np.arange(len(group)), group)
+                folds = folds.split(X=np.empty(num_data), groups=group_idx)
+            else:
+                folds = folds.split(X=np.empty(num_data))
+        return list(folds)
+    rng = np.random.RandomState(seed)
+    label = full_data.get_label()
+    if stratified:
+        # stratified fold assignment by label
+        idx = np.arange(num_data)
+        assignment = np.zeros(num_data, dtype=np.int64)
+        for lv in np.unique(label):
+            sel = idx[label == lv]
+            if shuffle:
+                rng.shuffle(sel)
+            assignment[sel] = np.arange(len(sel)) % nfold
+    else:
+        idx = np.arange(num_data)
+        if shuffle:
+            rng.shuffle(idx)
+        assignment = np.zeros(num_data, dtype=np.int64)
+        assignment[idx] = np.arange(num_data) % nfold
+    out = []
+    for f in range(nfold):
+        test_idx = np.nonzero(assignment == f)[0]
+        train_idx = np.nonzero(assignment != f)[0]
+        out.append((train_idx, test_idx))
+    return out
+
+
+def _agg_cv_result(raw_results):
+    """reference: engine.py:378-390."""
+    cvmap = {}
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            key = f"{one_line[0]} {one_line[1]}"
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, []).append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k], float(np.std(v)))
+            for k, v in cvmap.items()]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
+       show_stdv: bool = True, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """Cross-validation (reference: engine.py:392-470)."""
+    params = copy.deepcopy(params)
+    num_boost_round = _resolve_num_boost_round(params, num_boost_round)
+    if fobj is not None:
+        params["objective"] = "none"
+    if metrics is not None:
+        params["metric"] = metrics
+    if params.get("objective") in ("binary",) or str(params.get("objective", "")).startswith("multiclass"):
+        pass
+    else:
+        stratified = False
+
+    folds = _make_n_folds(train_set, folds, nfold, params, seed, stratified, shuffle)
+    cvbooster = CVBooster()
+    fold_data = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(train_idx)
+        te = train_set.subset(test_idx)
+        if fpreproc is not None:
+            tr, te, params = fpreproc(tr, te, params.copy())
+        fold_data.append((tr, te))
+
+    results: Dict[str, List[float]] = {}
+    boosters = []
+    for tr, te in fold_data:
+        b = Booster(params=params, train_set=tr)
+        b.add_valid(te, "valid")
+        boosters.append(b)
+        cvbooster._append(b)
+
+    cbs = set(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds, verbose=False))
+    if verbose_eval:
+        period = 1 if verbose_eval is True else int(verbose_eval)
+        cbs.add(callback_mod.print_evaluation(period, show_stdv))
+    cbs_after = sorted((c for c in cbs if not getattr(c, "before_iteration", False)),
+                       key=lambda c: getattr(c, "order", 0))
+
+    for i in range(num_boost_round):
+        raw = []
+        for b in boosters:
+            b.update(fobj=fobj)
+            if eval_train_metric:
+                raw.append(b.eval_set(feval))
+            else:
+                raw.append(b.eval_valid(feval))
+        agg = _agg_cv_result(raw)
+        for _, key, mean, _, std in agg:
+            results.setdefault(f"{key}-mean", []).append(mean)
+            results.setdefault(f"{key}-stdv", []).append(std)
+        try:
+            for cb in cbs_after:
+                cb(CallbackEnv(model=cvbooster, params=params, iteration=i,
+                               begin_iteration=0, end_iteration=num_boost_round,
+                               evaluation_result_list=agg))
+        except EarlyStopException as es:
+            cvbooster.best_iteration = es.best_iteration + 1
+            for k in list(results.keys()):
+                results[k] = results[k][:cvbooster.best_iteration]
+            break
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster
+    return results

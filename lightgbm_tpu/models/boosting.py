@@ -1,0 +1,23 @@
+"""Boosting factory (reference: src/boosting/boosting.cpp CreateBoosting)."""
+
+from __future__ import annotations
+
+from ..config import Config
+from ..utils import log
+from .gbdt import GBDT
+
+
+def create_boosting(config: Config, train_set=None):
+    name = config.boosting
+    if name == "gbdt":
+        return GBDT(config, train_set)
+    if name == "dart":
+        from .dart import DART
+        return DART(config, train_set)
+    if name == "goss":
+        from .goss import GOSS
+        return GOSS(config, train_set)
+    if name == "rf":
+        from .rf import RF
+        return RF(config, train_set)
+    log.fatal(f"Unknown boosting type: {name}")

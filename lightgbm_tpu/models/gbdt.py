@@ -1,0 +1,399 @@
+"""GBDT boosting orchestrator.
+
+TPU-native analog of the reference boosting layer (reference:
+src/boosting/gbdt.cpp GBDT): per-iteration flow mirrors GBDT::TrainOneIter
+(gbdt.cpp:369-452):
+
+  boost_from_average (first iter, gbdt.cpp:344-367)
+  -> objective gradients (Boosting(), gbdt.cpp:170-179)
+  -> bagging (gbdt.cpp:228-262; mask-based here to keep shapes static)
+  -> per-class tree growth (models/grower.py)
+  -> RenewTreeOutput (objective leaf refresh, gbdt.cpp:433)
+  -> Shrinkage (gbdt.cpp:411 tree->Shrinkage(lr))
+  -> UpdateScore train + valid (gbdt.cpp:369-452; out-of-bag rows included,
+     gbdt.cpp:434-452)
+
+Trees are stored both as device arrays (stacked lazily for batched ensemble
+prediction) and as host ``HostTree`` objects for model IO/SHAP.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..basic import Dataset
+from ..config import Config
+from ..metrics import Metric, create_metric, default_metric_for_objective
+from ..objectives import ObjectiveFunction, create_objective
+from ..ops.split import SplitParams
+from ..utils import log
+from .grower import grow_tree
+from .tree import (HostTree, TreeArrays, predict_leaf_bins, predict_value_bins,
+                   stack_trees)
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree (reference: gbdt.h:42, boosting.h:27)."""
+
+    name = "gbdt"
+    average_output = False
+
+    def __init__(self, config: Config, train_set: Optional[Dataset] = None,
+                 objective: Optional[ObjectiveFunction] = None):
+        self.config = config
+        self.train_set = train_set
+        self.objective = objective
+        self.trees: List[TreeArrays] = []       # device trees, leaf_value shrunk
+        self.host_trees: List[HostTree] = []
+        self.num_class = max(config.num_class, 1)
+        self.num_tree_per_iteration = 1
+        self.init_scores: List[float] = []
+        self.iter = 0
+        self._stacked_cache: Optional[Tuple[int, TreeArrays]] = None
+        self.valid_sets: List[Dataset] = []
+        self.valid_names: List[str] = []
+        self._valid_scores: List[jax.Array] = []
+        self.metric_names: List[str] = []
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        if train_set is not None:
+            self._init_train(train_set)
+
+    # ------------------------------------------------------------ setup
+    def _init_train(self, train_set: Dataset) -> None:
+        train_set.construct()
+        cfg = self.config
+        if cfg.monotone_constraints:
+            log.warning("monotone_constraints are not implemented yet and will be ignored")
+        if cfg.feature_contri:
+            log.warning("feature_contri is not implemented yet and will be ignored")
+        if self.objective is None:
+            self.objective = create_objective(cfg)
+        label = train_set.get_label()
+        weight = train_set.get_weight()
+        if self.objective is not None:
+            self.objective.init(label, weight, train_set.get_group())
+            self.num_tree_per_iteration = self.objective.num_model_per_iteration
+        else:
+            self.num_tree_per_iteration = max(cfg.num_class, 1)
+        n = train_set.num_data
+        k = self.num_tree_per_iteration
+        self._score_shape = (n, k) if k > 1 else (n,)
+        # boost_from_average init scores (gbdt.cpp:333-367)
+        self.init_scores = [0.0] * k
+        if self.objective is not None and cfg.boost_from_average:
+            for c in range(k):
+                self.init_scores[c] = float(self.objective.boost_from_score(c))
+        init = train_set.init_score
+        if init is not None:
+            base = np.asarray(init, dtype=np.float32).reshape(self._score_shape)
+        else:
+            base = np.broadcast_to(
+                np.asarray(self.init_scores, dtype=np.float32),
+                (n, k)).reshape(self._score_shape) if k > 1 else \
+                np.full((n,), self.init_scores[0], dtype=np.float32)
+        self.train_score = jnp.asarray(np.ascontiguousarray(base))
+        self.split_params = SplitParams.from_config(cfg)
+        # metric setup: one instance per (metric, dataset), created lazily
+        self.metric_names = [nm for nm in (cfg.metric or
+                                           default_metric_for_objective(cfg.objective))]
+        self._metric_cache: Dict[Tuple[str, int], Metric] = {}
+        # bagging / feature-fraction rngs (seeds per config.h:282,307)
+        self._bag_rng = np.random.RandomState(cfg.bagging_seed)
+        self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
+        self._bag_mask = jnp.ones((n,), dtype=jnp.float32)
+        self._need_bagging = (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0) or \
+            (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
+
+    def reset_config(self, config: Config) -> None:
+        """Apply updated parameters mid-training (reference: GBDT::ResetConfig,
+        gbdt.cpp; used by the reset_parameter callback / learning_rates)."""
+        self.config = config
+        self.split_params = SplitParams.from_config(config)
+        self._need_bagging = (config.bagging_freq > 0 and config.bagging_fraction < 1.0) or \
+            (config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0)
+
+    def add_valid(self, valid_set: Dataset, name: str) -> None:
+        valid_set.construct()
+        self.valid_sets.append(valid_set)
+        self.valid_names.append(name)
+        n = valid_set.num_data
+        k = self.num_tree_per_iteration
+        shape = (n, k) if k > 1 else (n,)
+        init = valid_set.init_score
+        if init is not None:
+            base = np.asarray(init, dtype=np.float32).reshape(shape)
+        else:
+            base = np.broadcast_to(np.asarray(self.init_scores, dtype=np.float32),
+                                   (n, k)).reshape(shape) if k > 1 else \
+                np.full((n,), self.init_scores[0], dtype=np.float32)
+        self._valid_scores.append(jnp.asarray(np.ascontiguousarray(base)))
+
+    # ---------------------------------------------------------- sampling
+    def _update_bagging(self) -> None:
+        """Bagging mask refresh (reference: gbdt.cpp:228-262 Bagging;
+        pos/neg bagging per config.h:268-280)."""
+        cfg = self.config
+        if not self._need_bagging:
+            return
+        if cfg.bagging_freq <= 0 or self.iter % cfg.bagging_freq != 0:
+            return
+        n = self.train_set.num_data
+        u = self._bag_rng.uniform(size=n)
+        if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
+            pos = self.objective.label_np > 0 if hasattr(self.objective, "label_np") \
+                else self.train_set.get_label() > 0
+            frac = np.where(pos, cfg.pos_bagging_fraction, cfg.neg_bagging_fraction)
+        else:
+            frac = cfg.bagging_fraction
+        self._bag_mask = jnp.asarray((u < frac).astype(np.float32))
+
+    def _feature_mask(self) -> jax.Array:
+        """Per-tree column sampling (reference: col_sampler.hpp:20-50
+        feature_fraction by-tree)."""
+        f = self.train_set.num_used_features()
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return jnp.ones((f,), dtype=jnp.float32)
+        k = max(1, int(round(f * frac)))
+        chosen = self._feat_rng.choice(f, size=k, replace=False)
+        mask = np.zeros((f,), dtype=np.float32)
+        mask[chosen] = 1.0
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------ train
+    def _gradients(self) -> Tuple[jax.Array, jax.Array]:
+        return self.objective.get_grad_hess(self.train_score)
+
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (gbdt.cpp:369-452). Returns True when the
+        iteration could not add any tree with a split (early stoppable)."""
+        cfg = self.config
+        ts = self.train_set
+        k = self.num_tree_per_iteration
+        if grad is None:
+            g, h = self._gradients()
+        else:
+            g = jnp.asarray(np.asarray(grad, dtype=np.float32).reshape(self._score_shape))
+            h = jnp.asarray(np.asarray(hess, dtype=np.float32).reshape(self._score_shape))
+        self._update_bagging()
+        mask = self._bag_mask
+        sample_weights = self._sample_weights(g, h)
+        no_split = True
+        for c in range(k):
+            gc = g[:, c] if k > 1 else g
+            hc = h[:, c] if k > 1 else h
+            fmask = self._feature_mask()
+            tree, leaf_id = grow_tree(
+                ts.bins, gc, hc, sample_weights if sample_weights is not None else mask,
+                ts.feature_meta, self.split_params, fmask, ts.missing_bin,
+                max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
+                max_depth=cfg.max_depth, hist_method=self._hist_method(),
+                exact=cfg.tree_growth_mode == "exact")
+            tree, had_split = self._finalize_tree(tree, leaf_id, c)
+            no_split = no_split and not had_split
+            self._add_tree(tree, leaf_id, c)
+        self.iter += 1
+        return no_split
+
+    def _hist_method(self) -> str:
+        m = self.config.histogram_method
+        return "scatter" if m == "auto" else m
+
+    def _sample_weights(self, g, h) -> Optional[jax.Array]:
+        """Hook for GOSS-style reweighted sampling; None = use bag mask."""
+        return None
+
+    def _finalize_tree(self, tree: TreeArrays, leaf_id: jax.Array,
+                       class_idx: int) -> Tuple[TreeArrays, bool]:
+        """RenewTreeOutput + Shrinkage (gbdt.cpp:411-433)."""
+        cfg = self.config
+        num_leaves = int(tree.num_leaves)
+        had_split = num_leaves > 1
+        if (had_split and self.objective is not None
+                and self.objective.need_renew_tree_output):
+            score = np.asarray(self.train_score if self.num_tree_per_iteration == 1
+                               else self.train_score[:, class_idx], dtype=np.float64)
+            new_values = self.objective.renew_tree_output(
+                np.asarray(leaf_id), score, num_leaves)
+            if new_values is not None:
+                lv = np.asarray(tree.leaf_value).copy()
+                lv[:num_leaves] = new_values
+                tree = tree._replace(leaf_value=jnp.asarray(lv))
+        lr = cfg.learning_rate
+        tree = tree._replace(leaf_value=tree.leaf_value * lr,
+                             node_value=tree.node_value * lr,
+                             shrinkage=tree.shrinkage * lr)
+        return tree, had_split
+
+    def _add_tree(self, tree: TreeArrays, leaf_id: jax.Array, class_idx: int) -> None:
+        """Score updates for train (via leaf ids — no traversal needed) and
+        valid sets (tree traversal on their binned matrices)."""
+        delta = tree.leaf_value[leaf_id]
+        if self.num_tree_per_iteration > 1:
+            self.train_score = self.train_score.at[:, class_idx].add(delta)
+        else:
+            self.train_score = self.train_score + delta
+        for i, vs in enumerate(self.valid_sets):
+            vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin)
+            if self.num_tree_per_iteration > 1:
+                self._valid_scores[i] = self._valid_scores[i].at[:, class_idx].add(vdelta)
+            else:
+                self._valid_scores[i] = self._valid_scores[i] + vdelta
+        self.trees.append(tree)
+        self._append_host_tree(tree)
+        self._stacked_cache = None
+
+    def _append_host_tree(self, tree: TreeArrays) -> None:
+        ds = self.train_set
+        num_leaves = int(tree.num_leaves)
+        n_nodes = max(num_leaves - 1, 0)
+        feats = np.asarray(tree.node_feature[:n_nodes])
+        bins_thr = np.asarray(tree.node_threshold_bin[:n_nodes])
+        real_thr = np.zeros(n_nodes, dtype=np.float64)
+        used = ds.used_features
+        for i in range(n_nodes):
+            mapper = ds.mappers[used[feats[i]]]
+            real_thr[i] = mapper.bin_to_value(int(bins_thr[i]))
+        full_thr = np.zeros(tree.node_threshold_bin.shape[0], dtype=np.float64)
+        full_thr[:n_nodes] = real_thr
+        self.host_trees.append(HostTree(tree, full_thr, used))
+
+    def rollback_one_iter(self) -> None:
+        """reference: gbdt.cpp:454-470 RollbackOneIter."""
+        if self.iter <= 0:
+            return
+        k = self.num_tree_per_iteration
+        for c in range(k):
+            tree = self.trees.pop()
+            self.host_trees.pop()
+            class_idx = k - 1 - c
+            # recompute train deltas via traversal (leaf ids not stored)
+            delta = predict_value_bins(tree, self.train_set.bins,
+                                       self.train_set.missing_bin)
+            if k > 1:
+                self.train_score = self.train_score.at[:, class_idx].add(-delta)
+            else:
+                self.train_score = self.train_score - delta
+            for i, vs in enumerate(self.valid_sets):
+                vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin)
+                if k > 1:
+                    self._valid_scores[i] = self._valid_scores[i].at[:, class_idx].add(-vdelta)
+                else:
+                    self._valid_scores[i] = self._valid_scores[i] - vdelta
+        self.iter -= 1
+        self._stacked_cache = None
+
+    # ------------------------------------------------------------- eval
+    def eval_set(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        """Evaluate all metrics on train (if configured) and valid sets.
+        Returns (dataset_name, metric_name, value, bigger_is_better) tuples
+        (analog of GBDT::OutputMetric, gbdt.cpp:517-575)."""
+        out = []
+        sets = []
+        if self.config.is_provide_training_metric:
+            sets.append(("training", self.train_set, self.train_score))
+        for name, vs, score in zip(self.valid_names, self.valid_sets, self._valid_scores):
+            sets.append((name, vs, score))
+        for ds_name, ds, score in sets:
+            score_np = np.asarray(score, dtype=np.float64)
+            for name in self.metric_names:
+                key = (name, id(ds))
+                mm = self._metric_cache.get(key)
+                if mm is None:
+                    mm = create_metric(name, self.config)
+                    if mm is None:
+                        continue
+                    mm.init(ds.get_label(), ds.get_weight(), ds.get_group())
+                    self._metric_cache[key] = mm
+                val = mm.eval(score_np, self.objective)
+                out.append((ds_name, mm.name, val, mm.bigger_is_better))
+            if feval is not None:
+                out.extend(_call_feval(feval, score_np, ds, self.objective, ds_name))
+        return out
+
+    # ---------------------------------------------------------- predict
+    def _stacked(self, num_iteration: Optional[int] = None) -> Optional[TreeArrays]:
+        total_iters = len(self.trees) // self.num_tree_per_iteration
+        use_iters = total_iters if num_iteration is None or num_iteration <= 0 \
+            else min(num_iteration, total_iters)
+        n_trees = use_iters * self.num_tree_per_iteration
+        if n_trees == 0:
+            return None
+        if self._stacked_cache is not None and self._stacked_cache[0] == n_trees:
+            return self._stacked_cache[1]
+        stacked = stack_trees(self.trees[:n_trees])
+        self._stacked_cache = (n_trees, stacked)
+        return stacked
+
+    def predict_raw(self, X, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0) -> np.ndarray:
+        """Raw scores for new raw-feature data (binned via the train mappers;
+        the analog of GBDT::PredictRaw, gbdt_prediction.cpp:13-53)."""
+        bins = jnp.asarray(self.train_set.bin_new_data(X))
+        k = self.num_tree_per_iteration
+        n = bins.shape[0]
+        total_iters = len(self.trees) // k
+        # num_iteration counts iterations used FROM start_iteration
+        # (reference: c_api predict semantics, gbdt.h num_iteration_for_pred_)
+        if num_iteration is None or num_iteration <= 0:
+            end_iter = total_iters
+        else:
+            end_iter = min(start_iteration + num_iteration, total_iters)
+        out = np.tile(np.asarray(self.init_scores, dtype=np.float64), (n, 1))
+        mb = self.train_set.missing_bin
+        for it in range(start_iteration, end_iter):
+            for c in range(k):
+                tree = self.trees[it * k + c]
+                out[:, c] += np.asarray(predict_value_bins(tree, bins, mb))
+        return out if k > 1 else out[:, 0]
+
+    def predict(self, X, raw_score: bool = False,
+                num_iteration: Optional[int] = None,
+                start_iteration: int = 0) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, start_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        conv = np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+        return conv
+
+    def predict_leaf(self, X, num_iteration: Optional[int] = None,
+                     start_iteration: int = 0) -> np.ndarray:
+        """Per-tree leaf indices (reference: predict_leaf_index path)."""
+        bins = jnp.asarray(self.train_set.bin_new_data(X))
+        k = self.num_tree_per_iteration
+        total_iters = len(self.trees) // k
+        if num_iteration is None or num_iteration <= 0:
+            end_iter = total_iters
+        else:
+            end_iter = min(start_iteration + num_iteration, total_iters)
+        mb = self.train_set.missing_bin
+        cols = []
+        for it in range(start_iteration, end_iter):
+            for c in range(k):
+                cols.append(np.asarray(predict_leaf_bins(self.trees[it * k + c], bins, mb)))
+        return np.stack(cols, axis=1) if cols else np.zeros((bins.shape[0], 0), np.int32)
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    def current_iteration(self) -> int:
+        return self.iter
+
+
+def _call_feval(feval, score_np, ds, objective, ds_name="valid"):
+    """Adapt a user eval function returning (name, value, is_higher_better)
+    or a list of such tuples (reference: engine.py feval protocol)."""
+    results = []
+    fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+    for fe in fevals:
+        ret = fe(score_np, ds)
+        rets = ret if isinstance(ret, list) else [ret]
+        for name, val, bigger in rets:
+            results.append((ds_name, name, float(val), bool(bigger)))
+    return results

@@ -1,0 +1,178 @@
+"""Tree model object: fixed-capacity array representation + traversal.
+
+TPU-native analog of the reference's flat-array binary tree
+(reference: include/LightGBM/tree.h:62-231, src/io/tree.cpp). A tree with
+leaf capacity L has L-1 internal-node slots and L leaf slots; child links
+follow the reference's encoding: ``child >= 0`` is an internal node index,
+``child < 0`` is ``~leaf_index`` (tree.h ``left_child_``/``right_child_``).
+
+Thresholds are stored in BIN space for exact device traversal over the binned
+matrix (the training-data path), plus real-valued thresholds filled from the
+bin mappers for raw-feature traversal (reference: Tree::RealThreshold via
+``BinMapper::BinToValue``). Missing-value routing mirrors
+``Tree::NumericalDecision`` (tree.h:133+, decision_type missing flags).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TreeArrays(NamedTuple):
+    """Single tree as device arrays. Internal-node arrays have shape
+    [L-1], leaf arrays [L]; ``num_leaves`` is the used count."""
+    num_leaves: jax.Array        # int32 scalar (actual leaves used)
+    node_feature: jax.Array      # int32 [L-1] inner feature index
+    node_threshold_bin: jax.Array  # int32 [L-1]
+    node_default_left: jax.Array   # bool [L-1]
+    node_left: jax.Array         # int32 [L-1]  (>=0 node, <0 = ~leaf)
+    node_right: jax.Array        # int32 [L-1]
+    node_gain: jax.Array         # f32 [L-1] split gain
+    node_value: jax.Array        # f32 [L-1] internal output (pre-shrinkage)
+    node_weight: jax.Array       # f32 [L-1] sum_hessian at node
+    node_count: jax.Array        # f32 [L-1]
+    node_cat: jax.Array          # bool [L-1] categorical split flag
+    node_cat_bitset: jax.Array   # uint32 [L-1, CAT_WORDS] bin membership (left side)
+    leaf_value: jax.Array        # f32 [L] (shrinkage already applied by booster)
+    leaf_weight: jax.Array       # f32 [L] sum_hessian
+    leaf_count: jax.Array        # f32 [L]
+    leaf_depth: jax.Array        # int32 [L]
+    leaf_parent: jax.Array       # int32 [L]
+    shrinkage: jax.Array         # f32 scalar
+
+
+def empty_tree(max_leaves: int, cat_words: int = 8) -> TreeArrays:
+    li, lf = max_leaves - 1, max_leaves
+    i32 = lambda n, v=0: jnp.full((n,), v, dtype=jnp.int32)
+    f32 = lambda n: jnp.zeros((n,), dtype=jnp.float32)
+    return TreeArrays(
+        num_leaves=jnp.int32(1),
+        node_feature=i32(li), node_threshold_bin=i32(li),
+        node_default_left=jnp.zeros((li,), dtype=bool),
+        node_left=i32(li, -1), node_right=i32(li, -1),
+        node_gain=f32(li), node_value=f32(li), node_weight=f32(li),
+        node_count=f32(li),
+        node_cat=jnp.zeros((li,), dtype=bool),
+        node_cat_bitset=jnp.zeros((li, cat_words), dtype=jnp.uint32),
+        leaf_value=f32(lf), leaf_weight=f32(lf), leaf_count=f32(lf),
+        leaf_depth=i32(lf), leaf_parent=i32(lf, -1),
+        shrinkage=jnp.float32(1.0),
+    )
+
+
+def _decide_left_bins(bin_val, threshold_bin, default_left, missing_bin,
+                      is_cat, cat_bitset):
+    """Split decision in bin space.
+
+    ``missing_bin``: per-feature bin routed by default direction (-1 when the
+    feature has no missing routing; see ops/split.py mode analysis).
+    Categorical: left iff the bin's bit is set in the membership bitset
+    (reference: Tree::CategoricalDecision bitset FindInBitset, tree.h:133+).
+    """
+    num_default = (bin_val == missing_bin) & (missing_bin >= 0)
+    num_left = jnp.where(num_default, default_left, bin_val <= threshold_bin)
+    word = (bin_val >> 5).astype(jnp.int32)
+    bit = (bin_val & 31).astype(jnp.int32)
+    cat_words = jnp.take_along_axis(cat_bitset, word[:, None], axis=1)[:, 0]
+    cat_left = ((cat_words >> bit.astype(jnp.uint32)) & 1) == 1
+    return jnp.where(is_cat, cat_left, num_left)
+
+
+def predict_leaf_bins(tree: TreeArrays, bins: jax.Array,
+                      missing_bin: jax.Array) -> jax.Array:
+    """Leaf index per row by traversing over the binned matrix.
+
+    Args:
+      bins: [N, F] int bins.
+      missing_bin: [F] int32, per-feature default-routed bin or -1.
+    Returns [N] int32 leaf indices.
+    """
+    n = bins.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    def cond(state):
+        cur, _ = state
+        return jnp.any(cur >= 0)
+
+    def body(state):
+        cur, leaf = state
+        active = cur >= 0
+        node = jnp.maximum(cur, 0)
+        feat = tree.node_feature[node]
+        b = bins[rows, feat].astype(jnp.int32)
+        go_left = _decide_left_bins(
+            b, tree.node_threshold_bin[node], tree.node_default_left[node],
+            missing_bin[feat], tree.node_cat[node], tree.node_cat_bitset[node])
+        nxt = jnp.where(go_left, tree.node_left[node], tree.node_right[node])
+        nxt = jnp.where(active, nxt, cur)
+        new_leaf = jnp.where(active & (nxt < 0), ~nxt, leaf)
+        return nxt, new_leaf
+
+    init = (jnp.zeros((n,), dtype=jnp.int32),
+            jnp.zeros((n,), dtype=jnp.int32))
+    # single-leaf tree: no nodes to traverse
+    init_cur = jnp.where(tree.num_leaves <= 1, -1, 0) * jnp.ones((n,), jnp.int32)
+    _, leaf = jax.lax.while_loop(cond, body, (init_cur, init[1]))
+    return leaf
+
+
+def predict_value_bins(tree: TreeArrays, bins: jax.Array,
+                       missing_bin: jax.Array) -> jax.Array:
+    """Tree output per row (leaf_value already includes shrinkage)."""
+    leaf = predict_leaf_bins(tree, bins, missing_bin)
+    return tree.leaf_value[leaf]
+
+
+def stack_trees(trees: List[TreeArrays]) -> TreeArrays:
+    """Stack per-tree arrays with a leading T axis for scan-based ensemble
+    prediction (the analog of GBDT::PredictRaw's per-tree loop,
+    gbdt_prediction.cpp:13-53, but batched on device)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def predict_value_ensemble(stacked: TreeArrays, bins: jax.Array,
+                           missing_bin: jax.Array,
+                           num_trees: int | None = None) -> jax.Array:
+    """Sum of tree outputs over a stacked ensemble via lax.scan."""
+
+    def step(carry, tree):
+        return carry + predict_value_bins(tree, bins, missing_bin), None
+
+    total, _ = jax.lax.scan(step, jnp.zeros((bins.shape[0],), jnp.float32), stacked)
+    return total
+
+
+# --------------------------------------------------------------------- host
+class HostTree:
+    """Host-side (numpy) view of a trained tree for model IO, SHAP and
+    raw-feature prediction. Built once per tree after training."""
+
+    def __init__(self, arrays: TreeArrays, real_thresholds: np.ndarray,
+                 feature_indices: np.ndarray):
+        t = jax.tree.map(np.asarray, arrays)
+        self.num_leaves = int(t.num_leaves)
+        n = max(self.num_leaves - 1, 0)
+        self.split_feature = t.node_feature[:n].astype(np.int32)
+        self.threshold_bin = t.node_threshold_bin[:n]
+        self.threshold = real_thresholds[:n]
+        self.default_left = t.node_default_left[:n]
+        self.left_child = t.node_left[:n]
+        self.right_child = t.node_right[:n]
+        self.split_gain = t.node_gain[:n]
+        self.internal_value = t.node_value[:n]
+        self.internal_weight = t.node_weight[:n]
+        self.internal_count = t.node_count[:n]
+        self.is_cat = t.node_cat[:n]
+        self.cat_bitset = t.node_cat_bitset[:n]
+        self.leaf_value = t.leaf_value[:self.num_leaves]
+        self.leaf_weight = t.leaf_weight[:self.num_leaves]
+        self.leaf_count = t.leaf_count[:self.num_leaves]
+        self.leaf_depth = t.leaf_depth[:self.num_leaves]
+        self.leaf_parent = t.leaf_parent[:self.num_leaves]
+        self.shrinkage = float(t.shrinkage)
+        # map inner feature index -> original column index
+        self.feature_indices = feature_indices

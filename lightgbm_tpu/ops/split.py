@@ -1,0 +1,312 @@
+"""Vectorized best-split search over histograms.
+
+TPU-native re-design of the reference's per-feature threshold scan
+(reference: src/treelearner/feature_histogram.hpp:858-1050
+``FindBestThresholdSequentially`` and the gain/output formulas at
+feature_histogram.hpp:737-856). Where the reference runs a sequential
+two-direction scan per feature inside OpenMP, here cumulative sums over the
+bin axis evaluate EVERY (leaf, feature, direction, threshold) candidate at
+once, then a masked lexicographic argmax reproduces the reference's
+first-better-wins tie ordering.
+
+Semantics carried over exactly:
+
+- gain  = GetLeafGain(left) + GetLeafGain(right) compared against
+  ``min_gain_shift = GetLeafGain(parent) + min_gain_to_split`` (strict ``>``),
+  with stored gain = best_gain - min_gain_shift
+  (feature_histogram.hpp:103-112, 934-944).
+- leaf output = -ThresholdL1(sum_g, l1) / (sum_h + l2), clipped to
+  ±max_delta_step, then path-smoothed toward the parent output
+  (feature_histogram.hpp:737-764 CalculateSplittedLeafOutput).
+- missing handling (feature_histogram.hpp:166-213 FuncForNumricalL3 dispatch):
+  * num_bin > 2 and MissingType::Zero  -> two scans, default bin skipped from
+    both accumulations and from the threshold candidates (SKIP_DEFAULT_BIN).
+  * num_bin > 2 and MissingType::NaN   -> two scans, NaN bin (last bin)
+    excluded from directional accumulation so its mass rides with the default
+    direction (NA_AS_MISSING).
+  * otherwise -> single reverse scan; default_left=False forced for NaN
+    (feature_histogram.hpp:199-210).
+  Reverse scan => missing goes left (default_left=True); forward scan =>
+  missing goes right.
+- the accumulated direction's hessian starts at kEpsilon
+  (feature_histogram.hpp:882 ``sum_right_hessian = kEpsilon``).
+- min_data_in_leaf / min_sum_hessian_in_leaf validity masks
+  (feature_histogram.hpp:904-917).
+
+Deviation from the reference: counts come from an exactly-accumulated count
+channel instead of ``RoundInt(hess * num_data / sum_hessian)``
+(feature_histogram.hpp:869, 898) — exact counts, same constraint semantics.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+K_EPSILON = 1e-15          # reference: include/LightGBM/meta.h kEpsilon
+K_MIN_SCORE = -jnp.inf     # reference: kMinScore
+
+
+class FeatureMeta(NamedTuple):
+    """Per-feature static metadata arrays, all shape [F]."""
+    num_bins: jax.Array        # int32, total bins incl. NaN bin
+    missing_type: jax.Array    # int32, MISSING_{NONE,ZERO,NAN}
+    default_bin: jax.Array     # int32, bin of value 0.0
+    is_categorical: jax.Array  # bool
+    monotone: jax.Array        # int8, -1/0/+1 (0 = unconstrained)
+    penalty: jax.Array         # float32 feature_contri gain multiplier
+
+
+class SplitParams(NamedTuple):
+    """Split hyperparameters (dynamic scalars so param changes don't recompile)."""
+    lambda_l1: jax.Array
+    lambda_l2: jax.Array
+    max_delta_step: jax.Array
+    path_smooth: jax.Array
+    min_data_in_leaf: jax.Array
+    min_sum_hessian_in_leaf: jax.Array
+    min_gain_to_split: jax.Array
+    cat_l2: jax.Array
+    cat_smooth: jax.Array
+    max_cat_threshold: jax.Array
+    min_data_per_group: jax.Array
+    max_cat_to_onehot: jax.Array
+
+    @classmethod
+    def from_config(cls, config) -> "SplitParams":
+        f32 = jnp.float32
+        return cls(
+            lambda_l1=f32(config.lambda_l1),
+            lambda_l2=f32(config.lambda_l2),
+            max_delta_step=f32(config.max_delta_step),
+            path_smooth=f32(config.path_smooth),
+            min_data_in_leaf=f32(config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=f32(config.min_sum_hessian_in_leaf),
+            min_gain_to_split=f32(config.min_gain_to_split),
+            cat_l2=f32(config.cat_l2),
+            cat_smooth=f32(config.cat_smooth),
+            max_cat_threshold=jnp.int32(config.max_cat_threshold),
+            min_data_per_group=f32(config.min_data_per_group),
+            max_cat_to_onehot=jnp.int32(config.max_cat_to_onehot),
+        )
+
+
+class SplitInfo(NamedTuple):
+    """Per-leaf best split, struct-of-arrays of shape [L]
+    (reference: src/treelearner/split_info.hpp:22-90)."""
+    gain: jax.Array          # f32; -inf when unsplittable
+    feature: jax.Array       # int32 inner feature index
+    threshold: jax.Array     # int32 bin threshold (left: bin <= threshold)
+    default_left: jax.Array  # bool, direction for missing values
+    left_sum_g: jax.Array
+    left_sum_h: jax.Array
+    left_count: jax.Array    # f32 (weighted count channel)
+    right_sum_g: jax.Array
+    right_sum_h: jax.Array
+    right_count: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+    cat_bitset: jax.Array    # uint32[L, CAT_WORDS] categorical membership (0 when numerical)
+
+
+CAT_BITSET_WORDS = 8  # supports categorical splits over up to 256 bins
+
+
+def threshold_l1(s: jax.Array, l1: jax.Array) -> jax.Array:
+    """reference: feature_histogram.hpp:737-741 ThresholdL1."""
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def calculate_leaf_output(sum_g, sum_h, p: SplitParams, num_data, parent_output,
+                          lambda_l2=None):
+    """reference: feature_histogram.hpp:743-764 CalculateSplittedLeafOutput."""
+    l2 = p.lambda_l2 if lambda_l2 is None else lambda_l2
+    ret = -threshold_l1(sum_g, p.lambda_l1) / (sum_h + l2)
+    ret = jnp.where((p.max_delta_step > 0) & (jnp.abs(ret) > p.max_delta_step),
+                    jnp.sign(ret) * p.max_delta_step, ret)
+    use_smooth = p.path_smooth > K_EPSILON
+    n_over_s = num_data / jnp.where(use_smooth, p.path_smooth, 1.0)
+    smoothed = ret * (n_over_s / (n_over_s + 1.0)) + parent_output / (n_over_s + 1.0)
+    return jnp.where(use_smooth, smoothed, ret)
+
+
+def leaf_gain_given_output(sum_g, sum_h, output, p: SplitParams, lambda_l2=None):
+    """reference: feature_histogram.hpp:846-856 GetLeafGainGivenOutput."""
+    l2 = p.lambda_l2 if lambda_l2 is None else lambda_l2
+    sg = threshold_l1(sum_g, p.lambda_l1)
+    return -(2.0 * sg * output + (sum_h + l2) * output * output)
+
+
+def leaf_gain(sum_g, sum_h, p: SplitParams, num_data, parent_output, lambda_l2=None):
+    """reference: feature_histogram.hpp:826-843 GetLeafGain. Always routed
+    through the output (identical to the closed form when no clipping/smoothing)."""
+    out = calculate_leaf_output(sum_g, sum_h, p, num_data, parent_output, lambda_l2)
+    return leaf_gain_given_output(sum_g, sum_h, out, p, lambda_l2)
+
+
+def _directional_sums(hist_excl, leaf_sum_g, leaf_sum_h, leaf_cnt):
+    """Cumulative left/right sums for every threshold, both directions.
+
+    hist_excl: [L, F, B, 3] histogram with excluded bins zeroed.
+    Returns dict with fwd/rev (accumulated-side eps added like the reference).
+    Threshold t means: left = bins <= t (accumulated side fwd), right = bins > t.
+    """
+    csum = jnp.cumsum(hist_excl, axis=2)                       # [L, F, B, 3]
+    total_excl = csum[:, :, -1:, :]
+    # forward: left accumulates bins 0..t
+    fwd_left = csum
+    # reverse: right accumulates bins t+1..B-1 (of the non-excluded mass)
+    rev_right = total_excl - csum
+    lt = dict(
+        fwd_left_g=fwd_left[..., 0], fwd_left_h=fwd_left[..., 1] + K_EPSILON,
+        fwd_left_c=fwd_left[..., 2],
+        rev_right_g=rev_right[..., 0], rev_right_h=rev_right[..., 1] + K_EPSILON,
+        rev_right_c=rev_right[..., 2],
+    )
+    # complement side from the leaf's TRUE totals (includes missing mass):
+    b = (leaf_sum_g[:, None, None], leaf_sum_h[:, None, None], leaf_cnt[:, None, None])
+    lt["fwd_right_g"] = b[0] - lt["fwd_left_g"]
+    lt["fwd_right_h"] = b[1] - lt["fwd_left_h"]
+    lt["fwd_right_c"] = b[2] - lt["fwd_left_c"]
+    lt["rev_left_g"] = b[0] - lt["rev_right_g"]
+    lt["rev_left_h"] = b[1] - lt["rev_right_h"]
+    lt["rev_left_c"] = b[2] - lt["rev_right_c"]
+    return lt
+
+
+def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
+                     leaf_output, leaf_depth, meta: FeatureMeta, p: SplitParams,
+                     feature_mask: jax.Array, max_depth: int = -1) -> SplitInfo:
+    """Best split per leaf over all numerical features.
+
+    Args:
+      hist: [L, F, B, 3] (grad, hess, count).
+      leaf_sum_g/h/cnt/output/depth: [L] current leaf aggregates.
+      feature_mask: [F] or [L, F] float/bool validity (col sampling,
+        interaction constraints).
+      max_depth: static; leaves at max_depth get gain -inf (reference:
+        serial_tree_learner.cpp BeforeFindBestSplit depth guard).
+    Returns SplitInfo with arrays of shape [L].
+    """
+    L, F, B, _ = hist.shape
+    nb = meta.num_bins[None, :, None]                      # [1, F, 1]
+    bins = jnp.arange(B, dtype=jnp.int32)[None, None, :]   # [1, 1, B]
+
+    mode_a = (meta.num_bins > 2) & (meta.missing_type != MISSING_NONE)   # [F]
+    is_nan = meta.missing_type == MISSING_NAN
+    is_zero = meta.missing_type == MISSING_ZERO
+
+    excl = jnp.zeros((1, F, B), dtype=bool)
+    excl = excl | (mode_a & is_nan)[None, :, None] & (bins == nb - 1)
+    excl = excl | (mode_a & is_zero)[None, :, None] & (bins == meta.default_bin[None, :, None])
+    hist_excl = jnp.where(excl[:, :, :, None], 0.0, hist)
+
+    s = _directional_sums(hist_excl, leaf_sum_g, leaf_sum_h, leaf_cnt)
+
+    parent_out = leaf_output[:, None, None]
+    num_data = leaf_cnt[:, None, None]
+
+    def side_gain(g, h, c):
+        return leaf_gain(g, h, p, c, parent_out)
+
+    gain_fwd = side_gain(s["fwd_left_g"], s["fwd_left_h"], s["fwd_left_c"]) + \
+        side_gain(s["fwd_right_g"], s["fwd_right_h"], s["fwd_right_c"])
+    gain_rev = side_gain(s["rev_left_g"], s["rev_left_h"], s["rev_left_c"]) + \
+        side_gain(s["rev_right_g"], s["rev_right_h"], s["rev_right_c"])
+
+    min_gain_shift = (leaf_gain(leaf_sum_g, leaf_sum_h, p, leaf_cnt, leaf_output)
+                      + p.min_gain_to_split)[:, None, None]
+
+    def constraint_mask(lg, lh, lc, rg, rh, rc):
+        return ((lc >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
+                & (lh >= p.min_sum_hessian_in_leaf) & (rh >= p.min_sum_hessian_in_leaf))
+
+    valid_fwd = constraint_mask(s["fwd_left_g"], s["fwd_left_h"], s["fwd_left_c"],
+                                s["fwd_right_g"], s["fwd_right_h"], s["fwd_right_c"])
+    valid_rev = constraint_mask(s["rev_left_g"], s["rev_left_h"], s["rev_left_c"],
+                                s["rev_right_g"], s["rev_right_h"], s["rev_right_c"])
+
+    # threshold-range masks (see module docstring for the scan ranges)
+    thr_ok_common = bins <= nb - 2
+    fwd_ok = mode_a[None, :, None] & thr_ok_common
+    rev_upper = nb - 2 - (mode_a & is_nan)[None, :, None].astype(jnp.int32)
+    rev_ok = bins <= rev_upper
+    zero_thr_skip = (mode_a & is_zero)[None, :, None] & (bins == meta.default_bin[None, :, None])
+    fwd_ok = fwd_ok & ~zero_thr_skip
+    rev_ok = rev_ok & ~zero_thr_skip
+
+    fmask = feature_mask
+    if fmask.ndim == 1:
+        fmask = fmask[None, :]
+    fmask = (fmask.astype(bool) & ~meta.is_categorical)[..., None]   # [L|1, F, 1]
+
+    depth_ok = jnp.ones((L,), dtype=bool) if max_depth <= 0 else (leaf_depth < max_depth)
+    base_ok = fmask & depth_ok[:, None, None]
+
+    valid_fwd = valid_fwd & fwd_ok & base_ok & (gain_fwd > min_gain_shift) & ~jnp.isnan(gain_fwd)
+    valid_rev = valid_rev & rev_ok & base_ok & (gain_rev > min_gain_shift) & ~jnp.isnan(gain_rev)
+
+    gain_fwd = jnp.where(valid_fwd, gain_fwd, K_MIN_SCORE)
+    gain_rev = jnp.where(valid_rev, gain_rev, K_MIN_SCORE)
+
+    # ---- lexicographic argmax reproducing the reference's scan tie order:
+    # reverse scan runs first and keeps the first (=highest-threshold) maximum;
+    # forward replaces only on strictly greater gain (lowest threshold first).
+    # Across features: lowest feature index wins ties
+    # (serial_tree_learner.cpp:374-448 feature loop with strict operator>).
+    gains = jnp.stack([gain_rev, gain_fwd], axis=2)          # [L, F, 2, B]
+    farange = jnp.arange(F, dtype=jnp.int32)[None, :, None, None]
+    tpref = jnp.stack([bins, (B - 1) - bins], axis=2)        # rev: high t; fwd: low t
+    pref = ((F - 1) - farange) * (4 * B) + jnp.stack(
+        [jnp.full_like(bins, 2 * B), jnp.zeros_like(bins)], axis=2) + tpref
+
+    flat_gains = gains.reshape(L, -1)
+    best_gain = jnp.max(flat_gains, axis=1)
+    is_best = flat_gains == best_gain[:, None]
+    flat_pref = jnp.broadcast_to(pref, gains.shape).reshape(L, -1)
+    best_idx = jnp.argmax(jnp.where(is_best, flat_pref, -1), axis=1)
+
+    bf = (best_idx // (2 * B)).astype(jnp.int32)             # feature
+    rem = best_idx % (2 * B)
+    bdir = (rem // B).astype(jnp.int32)                      # 0=rev, 1=fwd
+    bt = (rem % B).astype(jnp.int32)                         # threshold bin
+
+    li = jnp.arange(L)
+
+    def pick(rev_name, fwd_name):
+        rev_v = s[rev_name][li, bf, bt]
+        fwd_v = s[fwd_name][li, bf, bt]
+        return jnp.where(bdir == 0, rev_v, fwd_v)
+
+    left_g = pick("rev_left_g", "fwd_left_g")
+    left_h = pick("rev_left_h", "fwd_left_h")
+    left_c = pick("rev_left_c", "fwd_left_c")
+    right_g = pick("rev_right_g", "fwd_right_g")
+    right_h = pick("rev_right_h", "fwd_right_h")
+    right_c = pick("rev_right_c", "fwd_right_c")
+
+    left_out = calculate_leaf_output(left_g, left_h, p, left_c, leaf_output)
+    right_out = calculate_leaf_output(right_g, right_h, p, right_c, leaf_output)
+
+    # default_left: reverse scan => True; forced False for NaN single-scan mode
+    # (feature_histogram.hpp:199-210)
+    nan_single = (is_nan & ~mode_a)[bf]
+    default_left = (bdir == 0) & ~nan_single
+
+    shift = min_gain_shift[:, 0, 0]
+    stored_gain = jnp.where(jnp.isfinite(best_gain), best_gain - shift, K_MIN_SCORE)
+
+    return SplitInfo(
+        gain=stored_gain.astype(jnp.float32),
+        feature=bf,
+        threshold=bt,
+        default_left=default_left,
+        left_sum_g=left_g, left_sum_h=left_h, left_count=left_c,
+        right_sum_g=right_g, right_sum_h=right_h, right_count=right_c,
+        left_output=left_out, right_output=right_out,
+        cat_bitset=jnp.zeros((L, CAT_BITSET_WORDS), dtype=jnp.uint32),
+    )

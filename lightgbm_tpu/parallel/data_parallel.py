@@ -1,0 +1,85 @@
+"""Distributed data-parallel tree growth over a device mesh.
+
+TPU-native re-design of the reference's distributed tree learners
+(reference: src/treelearner/data_parallel_tree_learner.cpp and the socket/MPI
+collective layer src/network/ it rides on — SURVEY.md §2.6). The reference
+shards ROWS across machines, reduces per-leaf histograms with
+``Network::ReduceScatter`` + ``HistogramSumReducer``
+(data_parallel_tree_learner.cpp:184-186, bin.h:44-57), allreduces the root
+sums (:125-152) and syncs the best split with an allreduce-max
+(parallel_tree_learner.h:191-214).
+
+Here the whole scheme collapses into one SPMD program under ``shard_map``:
+
+- rows (bins/grad/hess/sample-mask) are sharded over the ``data`` mesh axis;
+- local histograms are summed with ``jax.lax.psum`` over ICI — the analog of
+  the ReduceScatter+owner-search+SyncUpGlobalBestSplit dance. After the psum
+  every device holds identical global histograms, so split FINDING needs no
+  further communication at all: each device computes the same argmax
+  deterministically (no SplitInfo serialization, no allreduce-max);
+- the per-row partition update stays local to each shard.
+
+``grow_tree_dp`` is the shard_map-wrapped grower; the tree it returns is
+replicated (identical on every device), the leaf ids stay row-sharded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.split import FeatureMeta, SplitParams
+from ..models.grower import grow_tree
+from ..models.tree import TreeArrays
+
+
+def make_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    """Build a 1-D device mesh over the first ``num_devices`` devices
+    (the analog of the reference's machine-list bootstrap,
+    linkers_socket.cpp:24-63 — here just jax device enumeration)."""
+    devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(devs, (axis,))
+
+
+def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                 sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
+                 feature_mask: jax.Array, missing_bin: jax.Array, *,
+                 max_leaves: int, num_bins: int, max_depth: int = -1,
+                 hist_method: str = "scatter",
+                 exact: bool = False,
+                 axis: str = "data") -> Tuple[TreeArrays, jax.Array]:
+    """Grow one tree with rows sharded over ``mesh`` axis ``axis``.
+
+    Inputs may be host arrays; they are sharded on entry. Returns the
+    (replicated) tree and the row-sharded leaf ids.
+    """
+    n = bins.shape[0]
+    ndev = mesh.shape[axis]
+    if n % ndev != 0:
+        # pad rows to a multiple of the mesh size with zero-mass rows
+        pad = ndev - n % ndev
+        bins = jnp.concatenate([bins, jnp.zeros((pad, bins.shape[1]), bins.dtype)])
+        grad = jnp.concatenate([grad, jnp.zeros((pad,), grad.dtype)])
+        hess = jnp.concatenate([hess, jnp.zeros((pad,), hess.dtype)])
+        sample_mask = jnp.concatenate([sample_mask, jnp.zeros((pad,), sample_mask.dtype)])
+
+    grow = functools.partial(
+        grow_tree, max_leaves=max_leaves, num_bins=num_bins,
+        max_depth=max_depth, hist_method=hist_method, exact=exact,
+        axis_name=axis)
+
+    shard = jax.shard_map(
+        grow, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis),
+                  P(), P(), P(), P()),
+        out_specs=(P(), P(axis)),
+        check_vma=False)
+    tree, leaf_id = shard(bins, grad, hess, sample_mask, meta, params,
+                          feature_mask, missing_bin)
+    return tree, leaf_id[:n]

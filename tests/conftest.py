@@ -1,0 +1,38 @@
+"""Test environment: force CPU with 8 virtual devices so distributed-mesh
+tests run without TPU hardware (SURVEY.md environment notes; the analog of
+the reference testing distributed paths with in-process LocalCluster,
+test_dask.py:29)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU bootstrap (sitecustomize) overrides jax_platforms to
+# "axon,cpu"; force CPU-only so tests never touch (or hang on) the TPU tunnel.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+def _example_path(name):
+    return os.path.join("/root/reference/examples", name)
+
+
+@pytest.fixture(scope="session")
+def binary_example():
+    """The reference's binary_classification example data
+    (examples/binary_classification/binary.{train,test}; label in col 0)."""
+    train = np.loadtxt(_example_path("binary_classification/binary.train"))
+    test = np.loadtxt(_example_path("binary_classification/binary.test"))
+    return (train[:, 1:], train[:, 0], test[:, 1:], test[:, 0])

@@ -1,0 +1,131 @@
+"""Brute-force numpy re-implementation of leaf-wise GBDT tree growth with the
+reference's exact gain formulas (feature_histogram.hpp:737-856), used as a
+differential oracle for the jitted grower. Slow O(N*F*B) loops, no tricks."""
+
+from __future__ import annotations
+
+import numpy as np
+
+K_EPSILON = 1e-15
+
+MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
+
+
+def threshold_l1(s, l1):
+    return np.sign(s) * max(abs(s) - l1, 0.0)
+
+
+def leaf_output(sg, sh, l1, l2):
+    return -threshold_l1(sg, l1) / (sh + l2)
+
+
+def leaf_gain(sg, sh, l1, l2):
+    s = threshold_l1(sg, l1)
+    return s * s / (sh + l2)
+
+
+def best_split_feature(hist, total_g, total_h, total_c, num_bin, missing_type,
+                       default_bin, l1, l2, min_data, min_hess, min_gain):
+    """Best split for one feature's histogram [B, 3]; returns
+    (gain_minus_shift, threshold, default_left, left sums) or None.
+    Mirrors FindBestThresholdSequentially's two-direction scan."""
+    gain_shift = leaf_gain(total_g, total_h, l1, l2) + min_gain
+    mode_a = num_bin > 2 and missing_type != MISSING_NONE
+    best = None
+
+    def consider(gain, thr, dleft, lg, lh, lc, rg, rh, rc):
+        nonlocal best
+        if best is None or gain > best[0]:
+            best = (gain, thr, dleft, lg, lh, lc, rg, rh, rc)
+
+    excl = np.zeros(num_bin, dtype=bool)
+    if mode_a and missing_type == MISSING_NAN:
+        excl[num_bin - 1] = True
+    if mode_a and missing_type == MISSING_ZERO:
+        excl[default_bin] = True
+
+    # reverse scan (missing left)
+    rev_upper = num_bin - 2 - (1 if (mode_a and missing_type == MISSING_NAN) else 0)
+    for t in range(rev_upper, -1, -1):
+        if mode_a and missing_type == MISSING_ZERO and t == default_bin:
+            continue
+        rg = sum(hist[b, 0] for b in range(t + 1, num_bin) if not excl[b])
+        rh = sum(hist[b, 1] for b in range(t + 1, num_bin) if not excl[b]) + K_EPSILON
+        rc = sum(hist[b, 2] for b in range(t + 1, num_bin) if not excl[b])
+        lg, lh, lc = total_g - rg, total_h - rh, total_c - rc
+        if rc < min_data or rh < min_hess or lc < min_data or lh < min_hess:
+            continue
+        gain = leaf_gain(lg, lh, l1, l2) + leaf_gain(rg, rh, l1, l2)
+        if gain > gain_shift:
+            dleft = True
+            if missing_type == MISSING_NAN and not mode_a:
+                dleft = False
+            consider(gain, t, dleft, lg, lh, lc, rg, rh, rc)
+
+    # forward scan (missing right), mode A only
+    if mode_a:
+        for t in range(0, num_bin - 1):
+            if missing_type == MISSING_ZERO and t == default_bin:
+                continue
+            lg = sum(hist[b, 0] for b in range(0, t + 1) if not excl[b])
+            lh = sum(hist[b, 1] for b in range(0, t + 1) if not excl[b]) + K_EPSILON
+            lc = sum(hist[b, 2] for b in range(0, t + 1) if not excl[b])
+            rg, rh, rc = total_g - lg, total_h - lh, total_c - lc
+            if rc < min_data or rh < min_hess or lc < min_data or lh < min_hess:
+                continue
+            gain = leaf_gain(lg, lh, l1, l2) + leaf_gain(rg, rh, l1, l2)
+            if gain > gain_shift:
+                consider(gain, t, False, lg, lh, lc, rg, rh, rc)
+
+    if best is None:
+        return None
+    return (best[0] - gain_shift,) + best[1:]
+
+
+def grow_tree_reference(bins, grad, hess, num_bins_per_feat, missing_types,
+                        default_bins, missing_bin, num_leaves, l1=0.0, l2=0.0,
+                        min_data=20, min_hess=1e-3, min_gain=0.0):
+    """Exact leaf-wise growth; returns (leaf_id per row, leaf_values dict,
+    split log [(leaf, feature, threshold, default_left)])."""
+    n, f = bins.shape
+    leaf_id = np.zeros(n, dtype=np.int64)
+    leaf_values = {0: leaf_output(grad.sum(), hess.sum(), l1, l2)}
+    splits = []
+
+    def leaf_best(leaf):
+        rows = leaf_id == leaf
+        if rows.sum() == 0:
+            return None
+        tg, th, tc = grad[rows].sum(), hess[rows].sum(), float(rows.sum())
+        cand = None
+        for j in range(f):
+            hist = np.zeros((num_bins_per_feat[j], 3))
+            for b, g, h in zip(bins[rows, j], grad[rows], hess[rows]):
+                hist[b] += (g, h, 1.0)
+            r = best_split_feature(hist, tg, th, tc, num_bins_per_feat[j],
+                                   missing_types[j], default_bins[j],
+                                   l1, l2, min_data, min_hess, min_gain)
+            if r is not None and (cand is None or r[0] > cand[0]):
+                cand = r + (j,)
+        return cand
+
+    best_per_leaf = {0: leaf_best(0)}
+    while len(leaf_values) < num_leaves:
+        live = {k: v for k, v in best_per_leaf.items() if v is not None and v[0] > 0}
+        if not live:
+            break
+        leaf = max(live, key=lambda k: live[k][0])
+        gain, thr, dleft, lg, lh, lc, rg, rh, rc, j = live[leaf]
+        rows = leaf_id == leaf
+        col = bins[rows, j]
+        mb = missing_bin[j]
+        go_left = np.where((col == mb) & (mb >= 0), dleft, col <= thr)
+        new_leaf = len(leaf_values)
+        idx = np.nonzero(rows)[0]
+        leaf_id[idx[~go_left]] = new_leaf
+        leaf_values[leaf] = leaf_output(lg, lh, l1, l2)
+        leaf_values[new_leaf] = leaf_output(rg, rh, l1, l2)
+        splits.append((leaf, j, thr, dleft))
+        best_per_leaf[leaf] = leaf_best(leaf)
+        best_per_leaf[new_leaf] = leaf_best(new_leaf)
+    return leaf_id, leaf_values, splits

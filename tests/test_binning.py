@@ -1,0 +1,131 @@
+"""BinMapper semantics tests (reference behaviors from src/io/bin.cpp)."""
+
+import numpy as np
+
+from lightgbm_tpu import binning
+
+
+def test_distinct_values_get_own_bins():
+    vals = np.array([1.0, 2.0, 3.0, 1.0, 2.0, 3.0, 1.0] * 10)
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=len(vals), max_bin=255, min_data_in_bin=3)
+    assert m.missing_type == binning.MISSING_NONE
+    b = m.values_to_bins(np.array([1.0, 2.0, 3.0]))
+    assert len(set(b.tolist())) == 3
+    # ordering preserved
+    assert b[0] < b[1] < b[2]
+
+
+def test_bin_boundaries_monotone_and_count_balanced():
+    rng = np.random.RandomState(0)
+    vals = rng.normal(size=10000)
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=len(vals), max_bin=64, min_data_in_bin=3)
+    assert m.num_bin <= 64
+    bounds = m.bin_upper_bound
+    finite = bounds[np.isfinite(bounds)]
+    assert np.all(np.diff(finite) > 0)
+    bins = m.values_to_bins(vals)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    # equal-count greedy: occupied bins roughly balanced (the dedicated zero
+    # bin may be empty for continuous data, bin.cpp:256-314)
+    occupied = counts[counts > 0]
+    assert len(occupied) >= m.num_bin - 2
+    assert occupied.max() < 10 * occupied.mean()
+
+
+def test_zero_bin_dedicated():
+    # sparse feature: zeros dominate, dedicated zero bin straddling +-1e-35
+    vals = np.concatenate([np.zeros(900), np.linspace(1, 10, 100)])
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=len(vals), max_bin=255, min_data_in_bin=3)
+    zb = m.value_to_bin(0.0)
+    assert m.default_bin == zb
+    assert m.value_to_bin(1e-40) == zb
+    assert m.value_to_bin(1.0) != zb
+    assert m.sparse_rate >= 0.9
+
+
+def test_nan_goes_to_last_bin():
+    vals = np.concatenate([np.linspace(-5, 5, 900), np.full(100, np.nan)])
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=len(vals), max_bin=255, min_data_in_bin=3,
+               use_missing=True, zero_as_missing=False)
+    assert m.missing_type == binning.MISSING_NAN
+    assert m.value_to_bin(np.nan) == m.num_bin - 1
+    assert m.value_to_bin(0.0) < m.num_bin - 1
+
+
+def test_use_missing_false():
+    vals = np.concatenate([np.linspace(-5, 5, 900), np.full(100, np.nan)])
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=len(vals), max_bin=255, min_data_in_bin=3,
+               use_missing=False)
+    assert m.missing_type == binning.MISSING_NONE
+
+
+def test_zero_as_missing():
+    vals = np.concatenate([np.zeros(500), np.linspace(1, 10, 500)])
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=len(vals), max_bin=255, min_data_in_bin=3,
+               zero_as_missing=True)
+    assert m.missing_type == binning.MISSING_ZERO
+    # NaN maps to the zero/default bin in Zero mode (bin.h:479-481)
+    assert m.value_to_bin(np.nan) == m.default_bin
+
+
+def test_max_bin_respected():
+    rng = np.random.RandomState(1)
+    vals = rng.uniform(size=100000)
+    for mb in (16, 63, 255):
+        m = binning.BinMapper()
+        m.find_bin(vals, total_sample_cnt=len(vals), max_bin=mb, min_data_in_bin=3)
+        assert 2 <= m.num_bin <= mb
+
+
+def test_trivial_feature():
+    # constant feature: filtered out by pre-filter (bin.cpp:54-76 NeedFilter +
+    # bin.cpp:500-503), since no threshold puts min_split_data on both sides
+    vals = np.full(100, 7.0)
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=100, max_bin=255, min_data_in_bin=3,
+               min_split_data=20, pre_filter=True)
+    assert m.is_trivial
+
+
+def test_categorical_by_count():
+    # categories 0..4 with decreasing counts
+    vals = np.concatenate([np.full(c, i) for i, c in enumerate([500, 300, 100, 50, 10])])
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=len(vals), max_bin=255, min_data_in_bin=3,
+               bin_type=binning.BIN_TYPE_CATEGORICAL)
+    assert m.bin_type == binning.BIN_TYPE_CATEGORICAL
+    # bin 0 reserved for NaN/other; most frequent category gets bin 1
+    assert m.value_to_bin(0.0) == 1
+    assert m.value_to_bin(1.0) == 2
+    # unseen category maps to bin 0
+    assert m.value_to_bin(99.0) == 0
+
+
+def test_values_to_bins_roundtrip_boundaries():
+    rng = np.random.RandomState(3)
+    vals = rng.normal(size=5000)
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=len(vals), max_bin=32, min_data_in_bin=3)
+    bins = m.values_to_bins(vals)
+    # every value's bin upper bound must be >= value, and previous bound < value
+    ub = m.bin_upper_bound
+    assert np.all(vals <= ub[bins])
+    has_prev = bins > 0
+    assert np.all(vals[has_prev] > ub[bins[has_prev] - 1])
+
+
+def test_serialization_roundtrip():
+    rng = np.random.RandomState(4)
+    vals = np.concatenate([rng.normal(size=900), np.full(100, np.nan)])
+    m = binning.BinMapper()
+    m.find_bin(vals, total_sample_cnt=len(vals), max_bin=64, min_data_in_bin=3)
+    m2 = binning.BinMapper.from_dict(m.to_dict())
+    test_vals = np.array([-1.0, 0.0, 1.5, np.nan])
+    np.testing.assert_array_equal(m.values_to_bins(test_vals),
+                                  m2.values_to_bins(test_vals))

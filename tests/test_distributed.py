@@ -1,0 +1,88 @@
+"""Distributed data-parallel tests on the virtual 8-device CPU mesh
+(the analog of the reference testing multi-node with an in-process Dask
+LocalCluster, test_dask.py — here: real shard_map + psum over 8 XLA host
+devices)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.parallel.data_parallel import grow_tree_dp, make_mesh
+from lightgbm_tpu.models.grower import grow_tree
+
+from test_grower import _make_meta, _make_params, _partition_signature
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh(8)
+
+
+def _data(seed, n=512, f=4, b=16):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = np.ones(n, dtype=np.float32)
+    return bins, grad, hess
+
+
+@pytest.mark.parametrize("exact", [True, False])
+def test_dp_matches_single_device(mesh8, exact):
+    """Distributed growth must produce the same tree as single-device growth
+    (the analog of test_dask.py's distributed ~= local assertions, but exact:
+    psum of f32 partial histograms is deterministic)."""
+    bins, grad, hess = _data(0)
+    n, f = bins.shape
+    meta, missing_bin = _make_meta([16] * f)
+    params = _make_params(min_data=5)
+    args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones((n,), jnp.float32), meta, params,
+            jnp.ones((f,), jnp.float32), jnp.asarray(missing_bin))
+    tree_s, leaf_s = grow_tree(*args, max_leaves=16, num_bins=16, exact=exact)
+    tree_d, leaf_d = grow_tree_dp(mesh8, *args, max_leaves=16, num_bins=16,
+                                  exact=exact)
+    assert int(tree_s.num_leaves) == int(tree_d.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree_s.node_feature),
+                                  np.asarray(tree_d.node_feature))
+    np.testing.assert_array_equal(np.asarray(tree_s.node_threshold_bin),
+                                  np.asarray(tree_d.node_threshold_bin))
+    np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_d))
+    np.testing.assert_allclose(np.asarray(tree_s.leaf_value),
+                               np.asarray(tree_d.leaf_value), rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_dp_rows_not_divisible(mesh8):
+    """Row counts not divisible by the mesh size are padded with zero-mass
+    rows and must not change the result."""
+    bins, grad, hess = _data(1, n=509)
+    n, f = bins.shape
+    meta, missing_bin = _make_meta([16] * f)
+    params = _make_params(min_data=5)
+    args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones((n,), jnp.float32), meta, params,
+            jnp.ones((f,), jnp.float32), jnp.asarray(missing_bin))
+    tree_s, leaf_s = grow_tree(*args, max_leaves=8, num_bins=16)
+    tree_d, leaf_d = grow_tree_dp(mesh8, *args, max_leaves=8, num_bins=16)
+    assert leaf_d.shape[0] == n
+    np.testing.assert_array_equal(np.asarray(tree_s.node_feature)[:int(tree_s.num_leaves) - 1],
+                                  np.asarray(tree_d.node_feature)[:int(tree_d.num_leaves) - 1])
+    np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_d))
+
+
+def test_dp_bagging_mask(mesh8):
+    bins, grad, hess = _data(2)
+    n, f = bins.shape
+    rng = np.random.RandomState(3)
+    mask = (rng.uniform(size=n) < 0.7).astype(np.float32)
+    meta, missing_bin = _make_meta([16] * f)
+    params = _make_params(min_data=5)
+    args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(mask), meta, params,
+            jnp.ones((f,), jnp.float32), jnp.asarray(missing_bin))
+    tree_s, leaf_s = grow_tree(*args, max_leaves=8, num_bins=16)
+    tree_d, leaf_d = grow_tree_dp(mesh8, *args, max_leaves=8, num_bins=16)
+    np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_d))
