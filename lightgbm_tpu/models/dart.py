@@ -1,0 +1,173 @@
+"""DART boosting (Dropouts meet Multiple Additive Regression Trees).
+
+TPU-native re-implementation of the reference DART booster
+(reference: src/boosting/dart.hpp). Per iteration:
+
+  1. select a drop set of earlier iterations (skip_drop / drop_rate /
+     uniform_drop / max_drop semantics, dart.hpp:97-148 DroppingTrees),
+  2. remove the dropped trees' contribution from the training score so the
+     gradients see a "thinned" ensemble,
+  3. train the new tree with shrinkage lr/(1+k) (or the xgboost-mode rate),
+  4. normalize: every dropped tree's stored values shrink by k/(k+1)
+     (xgboost mode: k/(k+lr)) and all score caches are fixed up so they hold
+     exactly the new contribution (dart.hpp:150-199 Normalize).
+
+The three-step Shrinkage(-1)/Shrinkage(1/(k+1))/Shrinkage(-k) dance of the
+reference is algebraically collapsed here: with stored contribution v and
+k dropped trees, the net effect is v <- v * factor on the tree and on every
+score cache, with the training score additionally missing v entirely during
+gradient computation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..basic import Dataset
+from ..config import Config
+from ..objectives import ObjectiveFunction
+from .gbdt import GBDT
+from .tree import predict_value_bins
+
+
+class DART(GBDT):
+    """reference: dart.hpp:23 `class DART: public GBDT`."""
+
+    name = "dart"
+
+    def __init__(self, config: Config, train_set: Optional[Dataset] = None,
+                 objective: Optional[ObjectiveFunction] = None):
+        super().__init__(config, train_set, objective)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []   # per-iteration weights (dart.hpp:201)
+        self.sum_weight = 0.0
+
+    def reset_config(self, config: Config) -> None:
+        super().reset_config(config)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.sum_weight = sum(self.tree_weight)
+
+    # ------------------------------------------------------------- drop
+    def _select_drop_iters(self) -> List[int]:
+        """reference: dart.hpp:97-134 DroppingTrees (selection part)."""
+        cfg = self.config
+        if self._drop_rng.rand() < cfg.skip_drop:
+            return []
+        drop = []
+        if not cfg.uniform_drop and self.sum_weight > 0:
+            drop_rate = cfg.drop_rate
+            inv_avg = len(self.tree_weight) / self.sum_weight
+            if cfg.max_drop > 0:
+                drop_rate = min(drop_rate, cfg.max_drop * inv_avg / self.sum_weight)
+            for i in range(self.iter):
+                if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                    drop.append(i)
+                    if len(drop) >= cfg.max_drop > 0:
+                        break
+        else:
+            drop_rate = cfg.drop_rate
+            if cfg.max_drop > 0 and self.iter > 0:
+                drop_rate = min(drop_rate, cfg.max_drop / float(self.iter))
+            for i in range(self.iter):
+                if self._drop_rng.rand() < drop_rate:
+                    drop.append(i)
+                    if len(drop) >= cfg.max_drop > 0:
+                        break
+        return drop
+
+    def _tree_contribs(self, it: int):
+        """Traversal-based contribution of iteration ``it`` trees on train
+        and valid sets (scores are caches, dart.hpp drops via AddScore)."""
+        k = self.num_tree_per_iteration
+        ts = self.train_set
+        out = []
+        for c in range(k):
+            tree = self.trees[it * k + c]
+            train_delta = predict_value_bins(tree, ts.bins, ts.missing_bin)
+            valid_deltas = [predict_value_bins(tree, vs.bins, vs.missing_bin)
+                            for vs in self.valid_sets]
+            out.append((train_delta, valid_deltas))
+        return out
+
+    def _scale_stored_tree(self, idx: int, factor: float) -> None:
+        tree = self.trees[idx]
+        self.trees[idx] = tree._replace(
+            leaf_value=tree.leaf_value * factor,
+            node_value=tree.node_value * factor,
+            shrinkage=tree.shrinkage * factor)
+        host = self.host_trees[idx]
+        self.host_trees[idx] = host.scaled(factor)
+
+    # ------------------------------------------------------------ train
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        cfg = self.config
+        k_cls = self.num_tree_per_iteration
+        drop = self._select_drop_iters()
+        k = float(len(drop))
+
+        # step 1-2: remove dropped contribution from the train score
+        contribs = {}
+        for it in drop:
+            contribs[it] = self._tree_contribs(it)
+            for c in range(k_cls):
+                delta, _ = contribs[it][c]
+                if k_cls > 1:
+                    self.train_score = self.train_score.at[:, c].add(-delta)
+                else:
+                    self.train_score = self.train_score - delta
+
+        # shrinkage for the new tree (dart.hpp:136-147)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k)
+        else:
+            self.shrinkage_rate = cfg.learning_rate if not drop else \
+                cfg.learning_rate / (cfg.learning_rate + k)
+
+        ret = super().train_one_iter(grad, hess)
+        if ret:
+            # no split found; undo the drop to restore score caches. The
+            # (constant) trees were still appended and iter advanced, so the
+            # weight bookkeeping below must still run to stay in sync.
+            for it in drop:
+                for c in range(k_cls):
+                    delta, _ = contribs[it][c]
+                    if k_cls > 1:
+                        self.train_score = self.train_score.at[:, c].add(delta)
+                    else:
+                        self.train_score = self.train_score + delta
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+            return ret
+
+        # step 4: normalize (dart.hpp:150-199)
+        factor = (k / (k + 1.0)) if not cfg.xgboost_dart_mode else \
+            (k / (k + cfg.learning_rate))
+        for it in drop:
+            for c in range(k_cls):
+                delta, vdeltas = contribs[it][c]
+                if k_cls > 1:
+                    self.train_score = self.train_score.at[:, c].add(factor * delta)
+                else:
+                    self.train_score = self.train_score + factor * delta
+                for i, vd in enumerate(vdeltas):
+                    if k_cls > 1:
+                        self._valid_scores[i] = self._valid_scores[i].at[:, c].add(
+                            (factor - 1.0) * vd)
+                    else:
+                        self._valid_scores[i] = self._valid_scores[i] + (factor - 1.0) * vd
+                self._scale_stored_tree(it * k_cls + c, factor)
+            # weight bookkeeping runs in BOTH drop modes (the reference only
+            # tracks it when !uniform_drop, dart.hpp:178-181) so a later
+            # reset_config switching drop modes sees consistent weights.
+            self.sum_weight -= self.tree_weight[it] * (1.0 - factor)
+            self.tree_weight[it] *= factor
+        self._stacked_cache = None
+
+        self.tree_weight.append(self.shrinkage_rate)
+        self.sum_weight += self.shrinkage_rate
+        return False

@@ -96,6 +96,7 @@ class GBDT:
                 (n, k)).reshape(self._score_shape) if k > 1 else \
                 np.full((n,), self.init_scores[0], dtype=np.float32)
         self.train_score = jnp.asarray(np.ascontiguousarray(base))
+        self.shrinkage_rate = cfg.learning_rate
         self.split_params = SplitParams.from_config(cfg)
         # metric setup: one instance per (metric, dataset), created lazily
         self.metric_names = [nm for nm in (cfg.metric or
@@ -112,6 +113,7 @@ class GBDT:
         """Apply updated parameters mid-training (reference: GBDT::ResetConfig,
         gbdt.cpp; used by the reset_parameter callback / learning_rates)."""
         self.config = config
+        self.shrinkage_rate = config.learning_rate
         self.split_params = SplitParams.from_config(config)
         self._need_bagging = (config.bagging_freq > 0 and config.bagging_fraction < 1.0) or \
             (config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0)
@@ -183,13 +185,21 @@ class GBDT:
         self._update_bagging()
         mask = self._bag_mask
         sample_weights = self._sample_weights(g, h)
+        if sample_weights is not None:
+            # GOSS-style reweighting: grad/hess amplified, the 0/1 mask keeps
+            # the histogram count channel exact (reference: goss.hpp:103-150
+            # multiplies gradients_/hessians_ of sampled small-grad rows).
+            w = sample_weights
+            g = g * (w[:, None] if k > 1 else w)
+            h = h * (w[:, None] if k > 1 else w)
+            mask = (w > 0).astype(jnp.float32)
         no_split = True
         for c in range(k):
             gc = g[:, c] if k > 1 else g
             hc = h[:, c] if k > 1 else h
             fmask = self._feature_mask()
             tree, leaf_id = grow_tree(
-                ts.bins, gc, hc, sample_weights if sample_weights is not None else mask,
+                ts.bins, gc, hc, mask,
                 ts.feature_meta, self.split_params, fmask, ts.missing_bin,
                 max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
                 max_depth=cfg.max_depth, hist_method=self._hist_method(),
@@ -216,19 +226,24 @@ class GBDT:
         had_split = num_leaves > 1
         if (had_split and self.objective is not None
                 and self.objective.need_renew_tree_output):
-            score = np.asarray(self.train_score if self.num_tree_per_iteration == 1
-                               else self.train_score[:, class_idx], dtype=np.float64)
+            score = self._renew_score(class_idx)
             new_values = self.objective.renew_tree_output(
                 np.asarray(leaf_id), score, num_leaves)
             if new_values is not None:
                 lv = np.asarray(tree.leaf_value).copy()
                 lv[:num_leaves] = new_values
                 tree = tree._replace(leaf_value=jnp.asarray(lv))
-        lr = cfg.learning_rate
+        lr = self.shrinkage_rate
         tree = tree._replace(leaf_value=tree.leaf_value * lr,
                              node_value=tree.node_value * lr,
                              shrinkage=tree.shrinkage * lr)
         return tree, had_split
+
+    def _renew_score(self, class_idx: int) -> np.ndarray:
+        """Score array used for objective leaf renewal (RF overrides with the
+        constant init score, rf.hpp:133-136)."""
+        return np.asarray(self.train_score if self.num_tree_per_iteration == 1
+                          else self.train_score[:, class_idx], dtype=np.float64)
 
     def _add_tree(self, tree: TreeArrays, leaf_id: jax.Array, class_idx: int) -> None:
         """Score updates for train (via leaf ids — no traversal needed) and
@@ -311,7 +326,15 @@ class GBDT:
                     mm.init(ds.get_label(), ds.get_weight(), ds.get_group())
                     self._metric_cache[key] = mm
                 val = mm.eval(score_np, self.objective)
-                out.append((ds_name, mm.name, val, mm.bigger_is_better))
+                if isinstance(val, (list, tuple)):
+                    # multi-position metrics (ndcg@k / map@k) report one
+                    # entry per position (reference: rank_metric.hpp name_)
+                    names = mm.name if isinstance(mm.name, (list, tuple)) \
+                        else [mm.name] * len(val)
+                    for nm2, v2 in zip(names, val):
+                        out.append((ds_name, nm2, float(v2), mm.bigger_is_better))
+                else:
+                    out.append((ds_name, mm.name, val, mm.bigger_is_better))
             if feval is not None:
                 out.extend(_call_feval(feval, score_np, ds, self.objective, ds_name))
         return out

@@ -1,0 +1,186 @@
+"""Random Forest mode.
+
+TPU-native re-implementation of the reference RF booster
+(reference: src/boosting/rf.hpp). Differences from GBDT:
+
+- no shrinkage (rf.hpp:48 ``shrinkage_rate_ = 1.0``),
+- gradients are computed ONCE from the constant boost-from-average score
+  (rf.hpp:85-104 ``Boosting()`` called a single time at init),
+- bagging is mandatory (rf.hpp:35 CHECK),
+- each tree gets the per-class init score added as a bias (rf.hpp:135
+  ``AddBias``) and the score caches hold the RUNNING MEAN of tree outputs
+  (rf.hpp:139-141 MultiplyScore dance),
+- prediction averages tree outputs instead of summing and adds no separate
+  init score (``average_output``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..basic import Dataset
+from ..config import Config
+from ..objectives import ObjectiveFunction
+from ..utils import log
+from .gbdt import GBDT
+from .tree import TreeArrays
+
+
+class RF(GBDT):
+    """reference: rf.hpp:25 `class RF : public GBDT`."""
+
+    name = "rf"
+    average_output = True
+
+    def __init__(self, config: Config, train_set: Optional[Dataset] = None,
+                 objective: Optional[ObjectiveFunction] = None):
+        if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
+            log.fatal("RF mode requires bagging "
+                      "(bagging_freq > 0 and 0 < bagging_fraction < 1)")
+        if not (0.0 < config.feature_fraction <= 1.0):
+            log.fatal("RF mode requires 0 < feature_fraction <= 1")
+        super().__init__(config, train_set, objective)
+
+    def _init_train(self, train_set: Dataset) -> None:
+        super()._init_train(train_set)
+        if train_set.init_score is not None:
+            log.fatal("Cannot use init_score in RF mode")
+        self.shrinkage_rate = 1.0
+        n = train_set.num_data
+        k = self.num_tree_per_iteration
+        # score caches start at zero: the init score lives INSIDE the trees
+        # as a bias (rf.hpp:135), and scores hold running means of outputs.
+        self.train_score = jnp.zeros(self._score_shape, jnp.float32)
+        # constant-score gradients, computed once (rf.hpp:85-104)
+        const = np.broadcast_to(
+            np.asarray(self.init_scores, dtype=np.float32), (n, k))
+        const_score = jnp.asarray(np.ascontiguousarray(
+            const.reshape(self._score_shape)))
+        if self.objective is None:
+            log.fatal("RF mode does not support custom objective functions")
+        self._const_score = const_score
+        self._fixed_grad, self._fixed_hess = \
+            self.objective.get_grad_hess(const_score)
+
+    def reset_config(self, config: Config) -> None:
+        super().reset_config(config)
+        self.shrinkage_rate = 1.0
+
+    def add_valid(self, valid_set: Dataset, name: str) -> None:
+        super().add_valid(valid_set, name)
+        n = valid_set.num_data
+        self._valid_scores[-1] = jnp.zeros(
+            (n, self.num_tree_per_iteration) if self.num_tree_per_iteration > 1
+            else (n,), jnp.float32)
+        if self.iter > 0:
+            # rebuild mean over existing trees (rf.hpp AddValidDataset)
+            from .tree import predict_value_bins
+            k = self.num_tree_per_iteration
+            acc = self._valid_scores[-1]
+            for it in range(self.iter):
+                for c in range(k):
+                    tree = self.trees[it * k + c]
+                    d = predict_value_bins(tree, valid_set.bins, valid_set.missing_bin)
+                    acc = acc.at[:, c].add(d) if k > 1 else acc + d
+            self._valid_scores[-1] = acc / float(self.iter)
+
+    def _gradients(self):
+        return self._fixed_grad, self._fixed_hess
+
+    def _renew_score(self, class_idx: int) -> np.ndarray:
+        k = self.num_tree_per_iteration
+        return np.asarray(self._const_score if k == 1
+                          else self._const_score[:, class_idx], dtype=np.float64)
+
+    def _finalize_tree(self, tree: TreeArrays, leaf_id, class_idx: int
+                       ) -> Tuple[TreeArrays, bool]:
+        tree, had_split = super()._finalize_tree(tree, leaf_id, class_idx)
+        bias = self.init_scores[class_idx]
+        if had_split and abs(bias) > 1e-15:
+            tree = tree._replace(leaf_value=tree.leaf_value + bias,
+                                 node_value=tree.node_value + bias)
+        return tree, had_split
+
+    def _add_tree(self, tree: TreeArrays, leaf_id, class_idx: int) -> None:
+        """Running-mean score update (rf.hpp:139-141):
+        score <- (score * m + tree_pred) / (m + 1)."""
+        from .tree import predict_value_bins
+        m = float(self.iter)
+        delta = tree.leaf_value[leaf_id]
+        k = self.num_tree_per_iteration
+        if k > 1:
+            col = (self.train_score[:, class_idx] * m + delta) / (m + 1.0)
+            self.train_score = self.train_score.at[:, class_idx].set(col)
+        else:
+            self.train_score = (self.train_score * m + delta) / (m + 1.0)
+        for i, vs in enumerate(self.valid_sets):
+            vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin)
+            if k > 1:
+                col = (self._valid_scores[i][:, class_idx] * m + vdelta) / (m + 1.0)
+                self._valid_scores[i] = self._valid_scores[i].at[:, class_idx].set(col)
+            else:
+                self._valid_scores[i] = (self._valid_scores[i] * m + vdelta) / (m + 1.0)
+        self.trees.append(tree)
+        self._append_host_tree(tree)
+        self._stacked_cache = None
+
+    def rollback_one_iter(self) -> None:
+        """Mean-aware rollback (reference: rf.hpp:168-184 RollbackOneIter):
+        score was mean of m trees; removing the last gives
+        (score * m - tree_pred) / (m - 1), or zero when m == 1."""
+        from .tree import predict_value_bins
+        if self.iter <= 0:
+            return
+        m = float(self.iter)
+        k = self.num_tree_per_iteration
+        for c in range(k):
+            tree = self.trees.pop()
+            self.host_trees.pop()
+            class_idx = k - 1 - c
+            delta = predict_value_bins(tree, self.train_set.bins,
+                                       self.train_set.missing_bin)
+            if m > 1:
+                if k > 1:
+                    col = (self.train_score[:, class_idx] * m - delta) / (m - 1.0)
+                    self.train_score = self.train_score.at[:, class_idx].set(col)
+                else:
+                    self.train_score = (self.train_score * m - delta) / (m - 1.0)
+            else:
+                self.train_score = jnp.zeros_like(self.train_score)
+            for i, vs in enumerate(self.valid_sets):
+                vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin)
+                if m > 1:
+                    if k > 1:
+                        col = (self._valid_scores[i][:, class_idx] * m - vdelta) / (m - 1.0)
+                        self._valid_scores[i] = self._valid_scores[i].at[:, class_idx].set(col)
+                    else:
+                        self._valid_scores[i] = (self._valid_scores[i] * m - vdelta) / (m - 1.0)
+                else:
+                    self._valid_scores[i] = jnp.zeros_like(self._valid_scores[i])
+        self.iter -= 1
+        self._stacked_cache = None
+
+    def predict_raw(self, X, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0) -> np.ndarray:
+        """Average of tree outputs (average_output_, gbdt_prediction.cpp)."""
+        from .tree import predict_value_bins
+        bins = jnp.asarray(self.train_set.bin_new_data(X))
+        k = self.num_tree_per_iteration
+        n = bins.shape[0]
+        total_iters = len(self.trees) // k
+        if num_iteration is None or num_iteration <= 0:
+            end_iter = total_iters
+        else:
+            end_iter = min(start_iteration + num_iteration, total_iters)
+        used = max(end_iter - start_iteration, 1)
+        out = np.zeros((n, k), dtype=np.float64)
+        mb = self.train_set.missing_bin
+        for it in range(start_iteration, end_iter):
+            for c in range(k):
+                tree = self.trees[it * k + c]
+                out[:, c] += np.asarray(predict_value_bins(tree, bins, mb))
+        out /= used
+        return out if k > 1 else out[:, 0]

