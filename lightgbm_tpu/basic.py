@@ -70,6 +70,10 @@ class Dataset:
         self.bins: Optional[jnp.ndarray] = None       # [N, F_used] device
         self.num_data: int = 0
         self.num_total_features: int = 0
+        # per-column category lists for pandas category dtypes; raw values
+        # are mapped to these codes at train AND predict time (reference:
+        # basic.py:504-568 pandas_categorical capture)
+        self.pandas_categorical: Dict[int, list] = {}
 
     # ------------------------------------------------------------ fields
     def set_label(self, label):
@@ -146,18 +150,35 @@ class Dataset:
                 out.append(int(c))
         return out
 
+    def _pandas_to_codes(self, raw):
+        """Convert pandas category-dtype columns to codes, capturing (train)
+        or reusing (predict) the category lists so train and predict agree
+        (reference: basic.py:504-568 _data_from_pandas pandas_categorical)."""
+        if not hasattr(raw, "dtypes"):
+            return raw
+        import pandas as pd  # noqa: F401
+        raw = raw.copy()
+        for ci, col in enumerate(raw.columns):
+            if str(raw[col].dtype) != "category":
+                continue
+            if ci in self.pandas_categorical:
+                cats = self.pandas_categorical[ci]
+                codes = pd.Categorical(raw[col], categories=cats).codes
+            else:
+                self.pandas_categorical[ci] = list(raw[col].cat.categories)
+                codes = raw[col].cat.codes
+            # unseen categories -> -1 -> NaN (routes to the other/NaN bin)
+            raw[col] = np.where(np.asarray(codes) >= 0,
+                                np.asarray(codes, dtype=np.float64), np.nan)
+        return raw
+
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
         config = Config.from_params(self.params)
-        raw = self.data
-        # pandas categorical columns -> codes
-        if hasattr(raw, "dtypes"):
-            import pandas as pd  # noqa
-            raw = raw.copy()
-            for col in raw.columns:
-                if str(raw[col].dtype) == "category":
-                    raw[col] = raw[col].cat.codes
+        if self.reference is not None:
+            self.pandas_categorical = self.reference.construct().pandas_categorical
+        raw = self._pandas_to_codes(self.data)
         X = _to_2d_float(raw)
         self.num_data, self.num_total_features = X.shape
         if self.feature_name == "auto" or self.feature_name is None:
@@ -177,6 +198,7 @@ class Dataset:
             self._feature_meta = ref._feature_meta
             self._missing_bin = ref._missing_bin
             self.max_num_bins = ref.max_num_bins
+            self.has_categorical = ref.has_categorical
         else:
             cats = self._resolve_categorical(self.num_total_features, self._feature_names)
             self.mappers = binning.find_bin_mappers(X, config, cats)
@@ -215,9 +237,7 @@ class Dataset:
         missing_bin = np.where(mode_a & (missing == binning.MISSING_NAN), nb - 1,
                                np.where(mode_a & (missing == binning.MISSING_ZERO),
                                         default_bin, -1)).astype(np.int32)
-        if is_cat.any():
-            log.warning("categorical feature splits are not implemented yet; "
-                        "categorical columns will not be used for splitting")
+        self.has_categorical = bool(is_cat.any())
         f = max(len(used), 1)
         self._feature_meta = FeatureMeta(
             num_bins=jnp.asarray(nb if len(nb) else np.array([2], np.int32)),
@@ -248,7 +268,7 @@ class Dataset:
     def bin_new_data(self, X) -> np.ndarray:
         """Bin raw features with this dataset's mappers (prediction path)."""
         self.construct()
-        X = _to_2d_float(X)
+        X = _to_2d_float(self._pandas_to_codes(X))
         if X.shape[1] != self.num_total_features:
             log.fatal(f"The number of features in data ({X.shape[1]}) is not the same"
                       f" as it was in training data ({self.num_total_features}).")

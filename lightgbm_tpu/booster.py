@@ -135,21 +135,28 @@ class Booster:
     def feature_importance(self, importance_type: str = "split",
                            iteration: Optional[int] = None) -> np.ndarray:
         """reference: gbdt.cpp FeatureImportance (split counts / total gains)."""
-        num_features = self._boosting.train_set.num_total_features
-        imp = np.zeros(num_features, dtype=np.float64)
-        for ht in self._boosting.host_trees:
-            for i in range(ht.num_leaves - 1):
-                real_feat = int(ht.feature_indices[ht.split_feature[i]])
-                if importance_type == "split":
-                    imp[real_feat] += 1.0
-                else:
-                    imp[real_feat] += max(float(ht.split_gain[i]), 0.0)
+        imp = self._boosting.feature_importance(importance_type)
         if importance_type == "split":
             return imp.astype(np.int32)
         return imp
 
     def feature_name(self) -> List[str]:
-        return self._boosting.train_set.get_feature_names()
+        b = self._boosting
+        ts = getattr(b, "train_set", None)
+        if ts is not None:
+            return ts.get_feature_names()
+        return list(b.feature_names)
 
     def num_feature(self) -> int:
-        return self._boosting.train_set.num_total_features
+        b = self._boosting
+        ts = getattr(b, "train_set", None)
+        if ts is not None:
+            return ts.num_total_features
+        return b.max_feature_idx + 1
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Replace this booster's model with one parsed from text
+        (reference: basic.py Booster.model_from_string)."""
+        from .io.model_text import load_model
+        self._boosting = load_model(model_str, self.config)
+        return self

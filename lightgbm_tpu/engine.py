@@ -50,10 +50,35 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
     first_metric_only = params.get("first_metric_only", False)
 
+    # continued training (reference: engine.py:163-169 — the init model's
+    # predictions seed the score caches, and its trees stay in the ensemble)
+    loaded = None
     if init_model is not None:
-        log.warning("init_model continued training is not yet implemented; starting fresh")
+        from .config import Config
+        from .io.model_text import load_model
+        if isinstance(init_model, Booster):
+            loaded = load_model(init_model.model_to_string(),
+                                Config.from_params(params))
+        else:
+            with open(init_model) as fh:
+                loaded = load_model(fh.read(), Config.from_params(params))
+        if loaded.num_trees > 0:
+            if train_set.data is None:
+                log.fatal("Cannot use init_model with a Dataset whose raw "
+                          "data was freed")
+            train_set.init_score = loaded.predict_raw(train_set.data)
+            for vs in (valid_sets or []):
+                if vs is train_set:
+                    continue
+                if vs.data is None:
+                    log.fatal("Cannot use init_model with a validation "
+                              "Dataset whose raw data was freed")
+                vs.init_score = loaded.predict_raw(vs.data)
 
     booster = Booster(params=params, train_set=train_set)
+    if loaded is not None and loaded.num_trees > 0:
+        booster._boosting.loaded = loaded
+        booster._boosting.loaded_iters = loaded.num_iteration
     valid_sets = valid_sets or []
     valid_names = valid_names or []
     for i, vs in enumerate(valid_sets):

@@ -1,0 +1,753 @@
+"""Model text serialization, v3-format compatible.
+
+Re-implements the reference's model text format (reference:
+src/boosting/gbdt_model_text.cpp:311-417 ``SaveModelToString`` /
+``LoadModelFromString`` and src/io/tree.cpp:336-410 ``Tree::ToString`` /
+tree.cpp:653+ parsing ctor) so models serialize to / load from the same
+``version=v3`` text layout the reference uses: header key=values, per-tree
+blocks with real-valued thresholds and packed ``decision_type`` bytes
+(cat bit | default-left bit | missing-type<<2, reference tree.h:19-20,269),
+feature_importances and an echoed parameters block.
+
+Loaded models predict by traversing REAL thresholds over raw features
+(reference: Tree::NumericalDecision / CategoricalDecision, tree.h:320-360) —
+no bin mappers are required after loading, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO, K_ZERO_THRESHOLD
+from ..config import Config
+from ..utils import log
+
+K_MODEL_VERSION = "v3"   # reference: gbdt_model_text.cpp:19 kModelVersion
+
+_CAT_MASK = 1            # reference: tree.h:19 kCategoricalMask
+_DEFAULT_LEFT_MASK = 2   # reference: tree.h:20 kDefaultLeftMask
+
+
+def _d2s(v: float) -> str:
+    """Shortest round-trip decimal for a double (the analog of the
+    reference's max_digits10 stream precision)."""
+    return repr(float(v))
+
+
+def _join(arr, fmt=str) -> str:
+    return " ".join(fmt(x) for x in arr)
+
+
+class ModelTree:
+    """One tree in model-text (real-value) space: original feature indices,
+    real thresholds, packed decision types. numpy arrays throughout."""
+
+    def __init__(self):
+        self.num_leaves = 1
+        self.num_cat = 0
+        self.split_feature = np.zeros(0, np.int32)
+        self.split_gain = np.zeros(0, np.float64)
+        self.threshold = np.zeros(0, np.float64)
+        self.decision_type = np.zeros(0, np.int8)
+        self.left_child = np.zeros(0, np.int32)
+        self.right_child = np.zeros(0, np.int32)
+        self.leaf_value = np.zeros(1, np.float64)
+        self.leaf_weight = np.zeros(1, np.float64)
+        self.leaf_count = np.zeros(1, np.int64)
+        self.internal_value = np.zeros(0, np.float64)
+        self.internal_weight = np.zeros(0, np.float64)
+        self.internal_count = np.zeros(0, np.int64)
+        self.cat_boundaries = np.zeros(1, np.int32)   # [num_cat+1]
+        self.cat_threshold = np.zeros(0, np.uint32)
+        self.shrinkage = 1.0
+        self.is_linear = False
+        self.leaf_const = np.zeros(0, np.float64)
+        self.leaf_features: List[List[int]] = []
+        self.leaf_coeff: List[List[float]] = []
+
+    # ------------------------------------------------------------- build
+    @classmethod
+    def from_host(cls, ht, mappers) -> "ModelTree":
+        """Convert a trained HostTree (bin space) to model space.
+
+        ``mappers``: the dataset's BinMapper list indexed by ORIGINAL feature.
+        Categorical bin-bitsets are re-encoded over raw category values
+        (the reference's cat_threshold stores category-value bitsets,
+        tree.h:349-360 CategoricalDecision on int(fval))."""
+        t = cls()
+        n = ht.num_leaves - 1
+        t.num_leaves = ht.num_leaves
+        t.split_feature = np.array(
+            [int(ht.feature_indices[f]) for f in ht.split_feature], np.int32)
+        t.split_gain = np.asarray(ht.split_gain, np.float64)
+        t.decision_type = np.zeros(n, np.int8)
+        t.threshold = np.asarray(ht.threshold, np.float64).copy()
+        t.left_child = np.asarray(ht.left_child, np.int32)
+        t.right_child = np.asarray(ht.right_child, np.int32)
+        t.leaf_value = np.asarray(ht.leaf_value, np.float64)
+        t.leaf_weight = np.asarray(ht.leaf_weight, np.float64)
+        t.leaf_count = np.asarray(np.round(ht.leaf_count), np.int64)
+        t.internal_value = np.asarray(ht.internal_value, np.float64)
+        t.internal_weight = np.asarray(ht.internal_weight, np.float64)
+        t.internal_count = np.asarray(np.round(ht.internal_count), np.int64)
+        t.shrinkage = ht.shrinkage
+        cat_boundaries = [0]
+        cat_words: List[int] = []
+        for i in range(n):
+            dt = 0
+            if bool(ht.is_cat[i]):
+                dt |= _CAT_MASK
+                mapper = mappers[t.split_feature[i]]
+                cats = [mapper.bin_2_categorical[b]
+                        for b in range(min(mapper.num_bin,
+                                           ht.cat_bitset.shape[1] * 32))
+                        if (int(ht.cat_bitset[i, b >> 5]) >> (b & 31)) & 1
+                        and mapper.bin_2_categorical[b] >= 0]
+                max_cat = max(cats) if cats else 0
+                n_words = max_cat // 32 + 1
+                words = [0] * n_words
+                for cval in cats:
+                    words[cval >> 5] |= 1 << (cval & 31)
+                t.threshold[i] = t.num_cat          # cat index into boundaries
+                t.num_cat += 1
+                cat_words.extend(words)
+                cat_boundaries.append(len(cat_words))
+            if bool(ht.default_left[i]):
+                dt |= _DEFAULT_LEFT_MASK
+            dt |= int(ht.missing_type[i]) << 2
+            t.decision_type[i] = dt
+        t.cat_boundaries = np.asarray(cat_boundaries, np.int32)
+        t.cat_threshold = np.asarray(cat_words, np.uint32)
+        return t
+
+    # -------------------------------------------------------------- text
+    def to_string(self) -> str:
+        """Tree block body (reference: tree.cpp:336-410 Tree::ToString)."""
+        n = self.num_leaves - 1
+        lines = [
+            f"num_leaves={self.num_leaves}",
+            f"num_cat={self.num_cat}",
+            "split_feature=" + _join(self.split_feature),
+            "split_gain=" + _join(self.split_gain, _d2s),
+            "threshold=" + _join(self.threshold, _d2s),
+            "decision_type=" + _join(self.decision_type),
+            "left_child=" + _join(self.left_child),
+            "right_child=" + _join(self.right_child),
+            "leaf_value=" + _join(self.leaf_value[:self.num_leaves], _d2s),
+            "leaf_weight=" + _join(self.leaf_weight[:self.num_leaves], _d2s),
+            "leaf_count=" + _join(self.leaf_count[:self.num_leaves]),
+            "internal_value=" + _join(self.internal_value[:n], _d2s),
+            "internal_weight=" + _join(self.internal_weight[:n], _d2s),
+            "internal_count=" + _join(self.internal_count[:n]),
+        ]
+        if self.num_cat > 0:
+            lines.append("cat_boundaries=" + _join(self.cat_boundaries))
+            lines.append("cat_threshold=" + _join(self.cat_threshold))
+        lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            lines.append("leaf_const=" + _join(self.leaf_const, _d2s))
+            lines.append("num_features=" + _join(
+                [len(f) for f in self.leaf_features]))
+            lines.append("leaf_features=" + " ".join(
+                (_join(f) + " ") if f else "" for f in self.leaf_features).rstrip() + " ")
+            lines.append("leaf_coeff=" + " ".join(
+                (_join(c, _d2s) + " ") if c else "" for c in self.leaf_coeff).rstrip() + " ")
+        lines.append(f"shrinkage={_d2s(self.shrinkage)}")
+        return "\n".join(lines) + "\n\n"
+
+    @classmethod
+    def from_kv(cls, kv: Dict[str, str]) -> "ModelTree":
+        """Parse one tree block (reference: tree.cpp:653+ Tree(const char*))."""
+        t = cls()
+        t.num_leaves = int(kv["num_leaves"])
+        t.num_cat = int(kv.get("num_cat", "0"))
+        n = t.num_leaves - 1
+
+        def arr(key, dtype, count, default=None):
+            s = kv.get(key, "")
+            if not s.strip():
+                if default is not None:
+                    return np.full(count, default, dtype)
+                return np.zeros(count, dtype)
+            return np.asarray(s.split(), dtype=dtype)
+
+        t.split_feature = arr("split_feature", np.int32, n)
+        t.split_gain = arr("split_gain", np.float64, n)
+        t.threshold = arr("threshold", np.float64, n)
+        t.decision_type = arr("decision_type", np.int8, n)
+        t.left_child = arr("left_child", np.int32, n)
+        t.right_child = arr("right_child", np.int32, n)
+        t.leaf_value = arr("leaf_value", np.float64, t.num_leaves)
+        t.leaf_weight = arr("leaf_weight", np.float64, t.num_leaves)
+        t.leaf_count = arr("leaf_count", np.int64, t.num_leaves)
+        t.internal_value = arr("internal_value", np.float64, n)
+        t.internal_weight = arr("internal_weight", np.float64, n)
+        t.internal_count = arr("internal_count", np.int64, n)
+        if t.num_cat > 0:
+            t.cat_boundaries = arr("cat_boundaries", np.int32, t.num_cat + 1)
+            t.cat_threshold = np.asarray(kv["cat_threshold"].split(),
+                                         dtype=np.uint64).astype(np.uint32)
+        t.is_linear = bool(int(kv.get("is_linear", "0")))
+        if t.is_linear:
+            t.leaf_const = arr("leaf_const", np.float64, t.num_leaves)
+            nf = arr("num_features", np.int32, t.num_leaves)
+            feats = kv.get("leaf_features", "").split()
+            coefs = kv.get("leaf_coeff", "").split()
+            pos = 0
+            for c in nf:
+                t.leaf_features.append([int(x) for x in feats[pos:pos + c]])
+                t.leaf_coeff.append([float(x) for x in coefs[pos:pos + c]])
+                pos += c
+        t.shrinkage = float(kv.get("shrinkage", "1"))
+        return t
+
+    # --------------------------------------------------------- traversal
+    def _go_left(self, nd: np.ndarray, fval: np.ndarray) -> np.ndarray:
+        """Vectorized split decision for node indices ``nd`` and raw feature
+        values ``fval`` (reference: tree.h:320-360 Numerical/CategoricalDecision)."""
+        dt = self.decision_type[nd]
+        missing_type = (dt.astype(np.int32) >> 2) & 3
+        default_left = (dt & _DEFAULT_LEFT_MASK) > 0
+        is_cat = (dt & _CAT_MASK) > 0
+
+        # NaN with non-NaN missing handling is treated as 0.0 (tree.h:330)
+        fv = np.where(np.isnan(fval) & (missing_type != MISSING_NAN), 0.0, fval)
+        is_missing = (((missing_type == MISSING_ZERO)
+                       & (np.abs(fv) <= K_ZERO_THRESHOLD))
+                      | ((missing_type == MISSING_NAN) & np.isnan(fv)))
+        with np.errstate(invalid="ignore"):
+            num_left = np.where(is_missing, default_left,
+                                fv <= self.threshold[nd])
+        if not is_cat.any():
+            return num_left
+        # categorical: membership of int(fval) in the node's value bitset
+        cat_left = np.zeros(len(nd), dtype=bool)
+        sel = np.nonzero(is_cat)[0]
+        for i in sel:
+            ci = int(self.threshold[nd[i]])
+            lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+            v = fval[i]
+            if np.isnan(v) or v < 0:
+                cat_left[i] = False
+                continue
+            iv = int(v)
+            w = iv >> 5
+            if w < hi - lo:
+                cat_left[i] = bool((int(self.cat_threshold[lo + w]) >> (iv & 31)) & 1)
+        return np.where(is_cat, cat_left, num_left)
+
+    def leaf_index(self, X: np.ndarray) -> np.ndarray:
+        """Per-row leaf index over raw features [N, F]."""
+        n = X.shape[0]
+        out = np.zeros(n, np.int32)
+        if self.num_leaves <= 1:
+            return out
+        cur = np.zeros(n, np.int32)
+        active = np.ones(n, dtype=bool)
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = cur[idx]
+            fval = X[idx, self.split_feature[nd]]
+            left = self._go_left(nd, fval)
+            nxt = np.where(left, self.left_child[nd], self.right_child[nd])
+            cur[idx] = nxt
+            done = nxt < 0
+            out[idx[done]] = ~nxt[done]
+            active[idx[done]] = False
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        leaf = self.leaf_index(X)
+        out = self.leaf_value[leaf]
+        if self.is_linear:
+            # linear leaves: const + sum(coeff * feature), NaN features
+            # fall back to the plain leaf value (linear_tree_learner.cpp:19-41)
+            lin = np.asarray(self.leaf_const)[leaf].copy()
+            ok = np.ones(len(leaf), dtype=bool)
+            for li in range(self.num_leaves):
+                rows = leaf == li
+                if not rows.any() or not self.leaf_features[li]:
+                    continue
+                feats = np.asarray(self.leaf_features[li], np.int64)
+                coefs = np.asarray(self.leaf_coeff[li], np.float64)
+                vals = X[np.ix_(rows, feats)]
+                bad = np.isnan(vals).any(axis=1) | np.isinf(vals).any(axis=1)
+                contrib = vals @ coefs
+                lin[rows] += np.where(bad, 0.0, contrib)
+                ok_rows = ok[rows]
+                ok_rows &= ~bad
+                ok[rows] = ok_rows
+            out = np.where(ok, lin, out)
+        return out
+
+    def depth_of(self) -> np.ndarray:
+        """Leaf depths (for plotting/JSON)."""
+        depth = np.zeros(self.num_leaves, np.int32)
+        ndepth = np.zeros(max(self.num_leaves - 1, 1), np.int32)
+        for i in range(self.num_leaves - 1):
+            for child in (self.left_child[i], self.right_child[i]):
+                if child >= 0:
+                    ndepth[child] = ndepth[i] + 1
+                else:
+                    depth[~child] = ndepth[i] + 1
+        return depth
+
+    def to_json_node(self, index: int = 0) -> dict:
+        """Nested node dict (reference: tree.cpp:412-520 Tree::ToJSON)."""
+        if self.num_leaves == 1:
+            return {"leaf_value": float(self.leaf_value[0])}
+        if index >= 0:
+            dt = int(self.decision_type[index])
+            is_cat = bool(dt & _CAT_MASK)
+            mt = (dt >> 2) & 3
+            node = {
+                "split_index": int(index),
+                "split_feature": int(self.split_feature[index]),
+                "split_gain": float(self.split_gain[index]),
+                "threshold": (self._cat_json_threshold(index) if is_cat
+                              else float(self.threshold[index])),
+                "decision_type": "==" if is_cat else "<=",
+                "default_left": bool(dt & _DEFAULT_LEFT_MASK),
+                "missing_type": {MISSING_NONE: "None", MISSING_ZERO: "Zero",
+                                 MISSING_NAN: "NaN"}[mt],
+                "internal_value": float(self.internal_value[index]),
+                "internal_weight": float(self.internal_weight[index]),
+                "internal_count": int(self.internal_count[index]),
+                "left_child": self.to_json_node(int(self.left_child[index])),
+                "right_child": self.to_json_node(int(self.right_child[index])),
+            }
+            return node
+        li = ~index
+        return {
+            "leaf_index": int(li),
+            "leaf_value": float(self.leaf_value[li]),
+            "leaf_weight": float(self.leaf_weight[li]),
+            "leaf_count": int(self.leaf_count[li]),
+        }
+
+    def _cat_json_threshold(self, index: int) -> str:
+        ci = int(self.threshold[index])
+        lo, hi = int(self.cat_boundaries[ci]), int(self.cat_boundaries[ci + 1])
+        cats = []
+        for w in range(lo, hi):
+            bits = int(self.cat_threshold[w])
+            for b in range(32):
+                if (bits >> b) & 1:
+                    cats.append((w - lo) * 32 + b)
+        return "||".join(str(c) for c in cats)
+
+
+# ===================================================================== dump
+def _objective_string(config: Config) -> Optional[str]:
+    obj = config.objective
+    if obj in ("none", "", None):
+        return None
+    if obj == "binary":
+        return f"binary sigmoid:{config.sigmoid:g}"
+    if obj == "multiclass":
+        return f"multiclass num_class:{config.num_class}"
+    if obj == "multiclassova":
+        return (f"multiclassova num_class:{config.num_class} "
+                f"sigmoid:{config.sigmoid:g}")
+    if obj == "quantile":
+        return f"quantile alpha:{config.alpha:g}"
+    if obj == "huber":
+        return f"huber alpha:{config.alpha:g}"
+    if obj == "fair":
+        return f"fair c:{config.fair_c:g}"
+    if obj == "tweedie":
+        return f"tweedie tweedie_variance_power:{config.tweedie_variance_power:g}"
+    if obj == "lambdarank":
+        return "lambdarank"
+    if obj == "cross_entropy":
+        return "cross_entropy"
+    if obj == "cross_entropy_lambda":
+        return "cross_entropy_lambda"
+    return obj
+
+
+def _feature_infos(mappers) -> List[str]:
+    """Per-feature info strings (reference: bin.h:190-199 bin_info_string)."""
+    from .. import binning
+    infos = []
+    for m in mappers:
+        if m.is_trivial:
+            infos.append("none")
+        elif m.bin_type == binning.BIN_TYPE_CATEGORICAL:
+            infos.append(":".join(str(c) for c in m.bin_2_categorical if c >= 0))
+        else:
+            infos.append(f"[{m.min_val:.17g}:{m.max_val:.17g}]")
+    return infos
+
+
+def _collect_model_trees(boosting, num_iteration: int = -1,
+                         start_iteration: int = 0
+                         ) -> Tuple[dict, List[ModelTree]]:
+    """Header metadata + ModelTree list for either a trained GBDT or a
+    LoadedGBDT, honoring start/num iteration windows
+    (reference: gbdt_model_text.cpp:343-356)."""
+    if isinstance(boosting, LoadedGBDT):
+        meta = dict(boosting.meta)
+        all_trees = list(boosting.trees)
+        k = boosting.num_tree_per_iteration
+    else:
+        cfg = boosting.config
+        ds = boosting.train_set
+        k = boosting.num_tree_per_iteration
+        meta = {
+            "num_class": boosting.num_class,
+            "num_tree_per_iteration": k,
+            "label_index": 0,
+            "max_feature_idx": ds.num_total_features - 1,
+            "objective": _objective_string(cfg),
+            "average_output": boosting.average_output,
+            "feature_names": ds.get_feature_names(),
+            "monotone_constraints": list(cfg.monotone_constraints),
+            "feature_infos": _feature_infos(ds.mappers),
+            "parameters": cfg.to_params(),
+            "pandas_categorical": {int(k): list(v) for k, v in
+                                   ds.pandas_categorical.items()},
+        }
+        all_trees = []
+        if boosting.loaded is not None:
+            all_trees.extend(boosting.loaded.trees)
+        for ht in boosting.host_trees:
+            all_trees.append(ModelTree.from_host(ht, ds.mappers))
+    total_iteration = len(all_trees) // max(k, 1)
+    start_iteration = min(max(start_iteration, 0), total_iteration)
+    if num_iteration is not None and num_iteration > 0:
+        end_iteration = min(start_iteration + num_iteration, total_iteration)
+    else:
+        end_iteration = total_iteration
+    trees = all_trees[start_iteration * k:end_iteration * k]
+    return meta, trees
+
+
+def dump_model_text(boosting, num_iteration: int = -1,
+                    start_iteration: int = 0) -> str:
+    """Serialize to the v3 text format
+    (reference: gbdt_model_text.cpp:311-403 SaveModelToString)."""
+    meta, trees = _collect_model_trees(boosting, num_iteration, start_iteration)
+    out = ["tree", f"version={K_MODEL_VERSION}",
+           f"num_class={meta['num_class']}",
+           f"num_tree_per_iteration={meta['num_tree_per_iteration']}",
+           f"label_index={meta['label_index']}",
+           f"max_feature_idx={meta['max_feature_idx']}"]
+    if meta.get("objective"):
+        out.append(f"objective={meta['objective']}")
+    if meta.get("average_output"):
+        out.append("average_output")
+    out.append("feature_names=" + " ".join(meta["feature_names"]))
+    if meta.get("monotone_constraints"):
+        out.append("monotone_constraints=" +
+                   " ".join(str(m) for m in meta["monotone_constraints"]))
+    out.append("feature_infos=" + " ".join(meta["feature_infos"]))
+
+    tree_strs = [f"Tree={i}\n" + t.to_string() + "\n"
+                 for i, t in enumerate(trees)]
+    out.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+    out.append("")
+    body = "\n".join(out) + "\n"
+    body += "".join(tree_strs)
+    body += "end of trees\n"
+
+    # feature importances, sorted descending (gbdt_model_text.cpp:370-392)
+    imp = np.zeros(meta["max_feature_idx"] + 1, np.float64)
+    for t in trees:
+        for f in t.split_feature:
+            imp[f] += 1
+    pairs = [(int(imp[i]), meta["feature_names"][i])
+             for i in range(len(imp)) if imp[i] > 0]
+    pairs.sort(key=lambda p: -p[0])
+    body += "\nfeature_importances:\n"
+    for cnt, name in pairs:
+        body += f"{name}={cnt}\n"
+
+    params = meta.get("parameters")
+    if params:
+        body += "\nparameters:\n"
+        for key, val in params.items():
+            if isinstance(val, (list, tuple)):
+                val = ",".join(str(v) for v in val)
+            body += f"[{key}: {val}]\n"
+        body += "end of parameters\n"
+    # pandas category lists so DataFrame prediction maps values the same way
+    # after loading (reference: basic.py save_model appends
+    # 'pandas_categorical:' JSON as the final line)
+    pc = meta.get("pandas_categorical")
+    if pc:
+        import json as _json
+        body += "\npandas_categorical:" + _json.dumps(
+            {str(k): v for k, v in pc.items()}) + "\n"
+    return body
+
+
+def dump_model_json(boosting, num_iteration: int = -1,
+                    start_iteration: int = 0) -> dict:
+    """JSON model dump (reference: gbdt_model_text.cpp:26-116 DumpModel)."""
+    meta, trees = _collect_model_trees(boosting, num_iteration, start_iteration)
+    tree_info = []
+    for i, t in enumerate(trees):
+        tree_info.append({
+            "tree_index": i,
+            "num_leaves": t.num_leaves,
+            "num_cat": t.num_cat,
+            "shrinkage": t.shrinkage,
+            "tree_structure": t.to_json_node(0),
+        })
+    return {
+        "name": "tree",
+        "version": K_MODEL_VERSION,
+        "num_class": meta["num_class"],
+        "num_tree_per_iteration": meta["num_tree_per_iteration"],
+        "label_index": meta["label_index"],
+        "max_feature_idx": meta["max_feature_idx"],
+        "objective": meta.get("objective") or "",
+        "average_output": bool(meta.get("average_output")),
+        "feature_names": meta["feature_names"],
+        "monotone_constraints": meta.get("monotone_constraints", []),
+        "feature_infos": {
+            name: info for name, info in zip(meta["feature_names"],
+                                             meta["feature_infos"])},
+        "tree_info": tree_info,
+    }
+
+
+# ===================================================================== load
+def _parse_objective(obj_str: str, config: Config) -> None:
+    """Apply an 'objective=' model line to the config
+    (inverse of _objective_string)."""
+    from ..config import _OBJECTIVE_ALIASES
+    toks = obj_str.split()
+    if not toks:
+        return
+    config.objective = _OBJECTIVE_ALIASES.get(toks[0], toks[0])
+    for tok in toks[1:]:
+        if ":" not in tok:
+            continue
+        key, val = tok.split(":", 1)
+        if key == "num_class":
+            config.num_class = int(val)
+        elif key == "sigmoid":
+            config.sigmoid = float(val)
+        elif key in ("alpha", "fair_c", "tweedie_variance_power"):
+            setattr(config, {"alpha": "alpha", "fair_c": "fair_c",
+                             "tweedie_variance_power": "tweedie_variance_power"}[key],
+                    float(val))
+
+
+class LoadedGBDT:
+    """A model restored from text: predicts over raw features via real
+    thresholds; supports re-serialization and serving as an init model
+    for continued training (reference: GBDT::LoadModelFromString,
+    gbdt_model_text.cpp:417-520)."""
+
+    def __init__(self, meta: dict, trees: List[ModelTree], config: Config):
+        self.meta = meta
+        self.trees = trees
+        self.config = config
+        self.num_class = meta["num_class"]
+        self.num_tree_per_iteration = meta["num_tree_per_iteration"]
+        self.average_output = bool(meta.get("average_output"))
+        self.feature_names = meta["feature_names"]
+        self.max_feature_idx = meta["max_feature_idx"]
+        from ..objectives import create_objective
+        try:
+            self.objective = create_objective(config)
+        except Exception:
+            self.objective = None
+        self.best_iteration = -1
+
+    # ------------------------------------------------------------ basics
+    @property
+    def num_iteration(self) -> int:
+        return len(self.trees) // max(self.num_tree_per_iteration, 1)
+
+    @property
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    def current_iteration(self) -> int:
+        return self.num_iteration
+
+    def _check_features(self, X) -> np.ndarray:
+        pc = self.meta.get("pandas_categorical") or {}
+        if hasattr(X, "dtypes") and pc:
+            import pandas as pd
+            X = X.copy()
+            for ci, col in enumerate(X.columns):
+                cats = pc.get(ci, pc.get(str(ci)))
+                if cats is not None and str(X[col].dtype) == "category":
+                    codes = np.asarray(
+                        pd.Categorical(X[col], categories=cats).codes)
+                    X[col] = np.where(codes >= 0,
+                                      codes.astype(np.float64), np.nan)
+        if hasattr(X, "values"):
+            X = X.values
+        if hasattr(X, "toarray"):
+            X = X.toarray()
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.shape[1] != self.max_feature_idx + 1:
+            log.fatal(f"The number of features in data ({X.shape[1]}) is not "
+                      f"the same as it was in training data "
+                      f"({self.max_feature_idx + 1}).")
+        return X
+
+    # ----------------------------------------------------------- predict
+    def predict_raw(self, X, num_iteration: Optional[int] = None,
+                    start_iteration: int = 0) -> np.ndarray:
+        X = self._check_features(X)
+        k = self.num_tree_per_iteration
+        total = self.num_iteration
+        if num_iteration is None or num_iteration <= 0:
+            end = total
+        else:
+            end = min(start_iteration + num_iteration, total)
+        out = np.zeros((X.shape[0], k), np.float64)
+        for it in range(start_iteration, end):
+            for c in range(k):
+                out[:, c] += self.trees[it * k + c].predict(X)
+        if self.average_output:
+            out /= max(end - start_iteration, 1)
+        return out if k > 1 else out[:, 0]
+
+    def predict(self, X, raw_score: bool = False,
+                num_iteration: Optional[int] = None,
+                start_iteration: int = 0) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, start_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        import jax.numpy as jnp
+        return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    def predict_leaf(self, X, num_iteration: Optional[int] = None,
+                     start_iteration: int = 0) -> np.ndarray:
+        X = self._check_features(X)
+        k = self.num_tree_per_iteration
+        total = self.num_iteration
+        if num_iteration is None or num_iteration <= 0:
+            end = total
+        else:
+            end = min(start_iteration + num_iteration, total)
+        cols = [self.trees[it * k + c].leaf_index(X)
+                for it in range(start_iteration, end) for c in range(k)]
+        return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0), np.int32)
+
+    def predict_contrib(self, X, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> np.ndarray:
+        from .shap import predict_contrib_trees
+        X = self._check_features(X)
+        k = self.num_tree_per_iteration
+        total = self.num_iteration
+        if num_iteration is None or num_iteration <= 0:
+            end = total
+        else:
+            end = min(start_iteration + num_iteration, total)
+        trees = [self.trees[it * k + c]
+                 for it in range(start_iteration, end) for c in range(k)]
+        return predict_contrib_trees(trees, X, self.max_feature_idx + 1, k,
+                                     average=self.average_output)
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        imp = np.zeros(self.max_feature_idx + 1, np.float64)
+        for t in self.trees:
+            for i in range(t.num_leaves - 1):
+                if importance_type == "split":
+                    imp[t.split_feature[i]] += 1.0
+                else:
+                    imp[t.split_feature[i]] += max(float(t.split_gain[i]), 0.0)
+        return imp
+
+    # ----------------------------------------------- Booster API adapters
+    def eval_set(self, feval=None):
+        log.fatal("Booster loaded from a model file has no attached data to evaluate")
+
+    def train_one_iter(self, grad=None, hess=None):
+        log.fatal("Cannot continue training a loaded Booster directly; pass it "
+                  "as init_model to train()")
+
+
+def load_model(model_str: str, config: Optional[Config] = None) -> LoadedGBDT:
+    """Parse a v3 model text (reference: gbdt_model_text.cpp:417-520)."""
+    config = config or Config()
+    lines = model_str.split("\n")
+    kv: Dict[str, str] = {}
+    i = 0
+    # header: key=value until the first Tree= block
+    while i < len(lines):
+        line = lines[i].strip()
+        if line.startswith("Tree=") or line == "end of trees":
+            break
+        if line and "=" in line:
+            key, val = line.split("=", 1)
+            kv[key] = val
+        elif line == "average_output":
+            kv["average_output"] = "1"
+        i += 1
+
+    trees: List[ModelTree] = []
+    while i < len(lines):
+        line = lines[i].strip()
+        if line == "end of trees":
+            break
+        if line.startswith("Tree="):
+            tkv: Dict[str, str] = {}
+            i += 1
+            while i < len(lines):
+                tl = lines[i].strip()
+                if not tl or tl.startswith("Tree=") or tl == "end of trees":
+                    break
+                if "=" in tl:
+                    key, val = tl.split("=", 1)
+                    tkv[key] = val
+                i += 1
+            trees.append(ModelTree.from_kv(tkv))
+        else:
+            i += 1
+
+    # parameters block (gbdt_model_text.cpp:507-516 loaded_parameter_)
+    params: Dict[str, str] = {}
+    pandas_categorical: Dict[int, list] = {}
+    in_params = False
+    for line in lines[i:]:
+        line = line.strip()
+        if line == "parameters:":
+            in_params = True
+        elif line == "end of parameters":
+            in_params = False
+        elif in_params and line.startswith("[") and ":" in line:
+            key, val = line[1:-1].split(":", 1)
+            params[key.strip()] = val.strip()
+        elif line.startswith("pandas_categorical:"):
+            import json as _json
+            try:
+                parsed = _json.loads(line[len("pandas_categorical:"):])
+                if isinstance(parsed, dict):
+                    pandas_categorical = {int(k): v for k, v in parsed.items()}
+            except (ValueError, TypeError):
+                pass
+
+    if "objective" in kv:
+        _parse_objective(kv["objective"], config)
+    if "num_class" in kv:
+        config.num_class = int(kv["num_class"])
+
+    meta = {
+        "num_class": int(kv.get("num_class", "1")),
+        "num_tree_per_iteration": int(kv.get("num_tree_per_iteration", "1")),
+        "label_index": int(kv.get("label_index", "0")),
+        "max_feature_idx": int(kv.get("max_feature_idx", "0")),
+        "objective": kv.get("objective"),
+        "average_output": "average_output" in kv,
+        "feature_names": kv.get("feature_names", "").split(),
+        "monotone_constraints": [int(x) for x in
+                                 kv.get("monotone_constraints", "").split()],
+        "feature_infos": kv.get("feature_infos", "").split(),
+        "parameters": params,
+        "pandas_categorical": pandas_categorical,
+    }
+    return LoadedGBDT(meta, trees, config)

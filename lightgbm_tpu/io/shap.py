@@ -1,0 +1,188 @@
+"""TreeSHAP feature contributions (pred_contrib).
+
+Implements the polynomial-time TreeSHAP algorithm (Lundberg et al.) that the
+reference exposes as ``Tree::PredictContrib`` / ``PredictContribByMap``
+(reference: include/LightGBM/tree.h:139-141, src/io/tree.cpp TreeSHAP
+implementation; surfaced via predict(..., pred_contrib=True),
+c_api.h:802). Output layout matches the reference: per class, one column per
+feature plus a final bias column holding the tree-ensemble expected value
+(tests/python_package_test/test_engine.py:1011-1117 contract: contribs sum
+to the raw prediction).
+
+This host-side implementation walks each ModelTree (real-threshold space)
+per row. It is the reference-parity path; a batched device formulation is a
+future optimization.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, feature_index=-1, zero_fraction=0.0, one_fraction=0.0,
+                 pweight=0.0):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+    def copy(self):
+        return _PathElement(self.feature_index, self.zero_fraction,
+                            self.one_fraction, self.pweight)
+
+
+def _extend_path(path: List[_PathElement], unique_depth: int,
+                 zero_fraction: float, one_fraction: float,
+                 feature_index: int) -> None:
+    path[unique_depth].feature_index = feature_index
+    path[unique_depth].zero_fraction = zero_fraction
+    path[unique_depth].one_fraction = one_fraction
+    path[unique_depth].pweight = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += (one_fraction * path[i].pweight * (i + 1)
+                                / (unique_depth + 1))
+        path[i].pweight = (zero_fraction * path[i].pweight
+                           * (unique_depth - i) / (unique_depth + 1))
+
+
+def _unwind_path(path: List[_PathElement], unique_depth: int,
+                 path_index: int) -> None:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0.0:
+            tmp = path[i].pweight
+            path[i].pweight = (next_one_portion * (unique_depth + 1)
+                               / ((i + 1) * one_fraction))
+            next_one_portion = tmp - (path[i].pweight * zero_fraction
+                                      * (unique_depth - i) / (unique_depth + 1))
+        else:
+            path[i].pweight = (path[i].pweight * (unique_depth + 1)
+                               / (zero_fraction * (unique_depth - i)))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
+                      path_index: int) -> float:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0.0:
+            tmp = (next_one_portion * (unique_depth + 1)
+                   / ((i + 1) * one_fraction))
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction * (
+                (unique_depth - i) / (unique_depth + 1))
+        else:
+            total += (path[i].pweight / zero_fraction
+                      / ((unique_depth - i) / (unique_depth + 1)))
+    return total
+
+
+def _tree_shap_recurse(tree, x: np.ndarray, phi: np.ndarray, node: int,
+                       unique_depth: int, parent_path: List[_PathElement],
+                       parent_zero_fraction: float,
+                       parent_one_fraction: float,
+                       parent_feature_index: int) -> None:
+    path = [p.copy() for p in parent_path[:unique_depth]]
+    path.extend(_PathElement() for _ in range(2))
+    _extend_path(path, unique_depth, parent_zero_fraction,
+                 parent_one_fraction, parent_feature_index)
+
+    if node < 0:   # leaf
+        li = ~node
+        leaf_value = float(tree.leaf_value[li])
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += (w * (el.one_fraction - el.zero_fraction)
+                                      * leaf_value)
+        return
+
+    feat = int(tree.split_feature[node])
+    left, right = int(tree.left_child[node]), int(tree.right_child[node])
+    go_left = bool(tree._go_left(np.array([node]), np.array([x[feat]]))[0])
+    hot, cold = (left, right) if go_left else (right, left)
+
+    node_count = float(tree.internal_count[node])
+
+    def child_count(c):
+        return float(tree.leaf_count[~c] if c < 0 else tree.internal_count[c])
+
+    hot_zero_fraction = child_count(hot) / node_count if node_count > 0 else 0.0
+    cold_zero_fraction = child_count(cold) / node_count if node_count > 0 else 0.0
+    incoming_zero_fraction = 1.0
+    incoming_one_fraction = 1.0
+
+    # if this feature was seen before on the path, undo that split
+    path_index = 0
+    while path_index <= unique_depth:
+        if path[path_index].feature_index == feat:
+            break
+        path_index += 1
+    if path_index != unique_depth + 1:
+        incoming_zero_fraction = path[path_index].zero_fraction
+        incoming_one_fraction = path[path_index].one_fraction
+        _unwind_path(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap_recurse(tree, x, phi, hot, unique_depth + 1, path,
+                       hot_zero_fraction * incoming_zero_fraction,
+                       incoming_one_fraction, feat)
+    _tree_shap_recurse(tree, x, phi, cold, unique_depth + 1, path,
+                       cold_zero_fraction * incoming_zero_fraction,
+                       0.0, feat)
+
+
+def tree_expected_value(tree) -> float:
+    """Count-weighted mean leaf output (reference: Tree::ExpectedValue)."""
+    if tree.num_leaves == 1:
+        return float(tree.leaf_value[0])
+    counts = np.asarray(tree.leaf_count[:tree.num_leaves], np.float64)
+    total = counts.sum()
+    if total <= 0:
+        return 0.0
+    return float((counts * np.asarray(
+        tree.leaf_value[:tree.num_leaves], np.float64)).sum() / total)
+
+
+def tree_shap_values(tree, x: np.ndarray, num_features: int) -> np.ndarray:
+    """SHAP contributions of one tree for one row: [num_features + 1]
+    (last = expected value)."""
+    phi = np.zeros(num_features + 1, np.float64)
+    phi[-1] = tree_expected_value(tree)
+    if tree.num_leaves > 1:
+        _tree_shap_recurse(tree, x, phi, 0, 0, [], 1.0, 1.0, -1)
+    return phi
+
+
+def predict_contrib_trees(trees, X: np.ndarray, num_features: int,
+                          num_tree_per_iteration: int = 1,
+                          average: bool = False) -> np.ndarray:
+    """SHAP contributions over an ensemble.
+
+    Returns [N, (num_features + 1) * k] with per-class blocks
+    (reference: gbdt.cpp PredictContrib layout)."""
+    n = X.shape[0]
+    k = max(num_tree_per_iteration, 1)
+    width = num_features + 1
+    out = np.zeros((n, width * k), np.float64)
+    for ti, tree in enumerate(trees):
+        c = ti % k
+        for r in range(n):
+            out[r, c * width:(c + 1) * width] += tree_shap_values(
+                tree, X[r], num_features)
+    if average and trees:
+        out /= (len(trees) // k)
+    return out

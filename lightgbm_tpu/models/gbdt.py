@@ -52,7 +52,12 @@ class GBDT:
         self.num_class = max(config.num_class, 1)
         self.num_tree_per_iteration = 1
         self.init_scores: List[float] = []
+        self.tree_bias: List[float] = []   # bias folded into each stored tree
         self.iter = 0
+        # continued training: a LoadedGBDT whose trees precede ours
+        # (reference: gbdt.h num_init_iteration_, engine.py:163-169)
+        self.loaded = None
+        self.loaded_iters = 0
         self._stacked_cache: Optional[Tuple[int, TreeArrays]] = None
         self.valid_sets: List[Dataset] = []
         self.valid_names: List[str] = []
@@ -88,6 +93,11 @@ class GBDT:
             for c in range(k):
                 self.init_scores[c] = float(self.objective.boost_from_score(c))
         init = train_set.init_score
+        # the auto init score is folded as a bias into the first tree of each
+        # class (gbdt.cpp:414-416 AddBias) UNLESS a user init score is set
+        # (gbdt.cpp:348 has_init_score check)
+        self._fold_init_bias = (init is None and cfg.boost_from_average
+                                and self.objective is not None)
         if init is not None:
             base = np.asarray(init, dtype=np.float32).reshape(self._score_shape)
         else:
@@ -203,10 +213,12 @@ class GBDT:
                 ts.feature_meta, self.split_params, fmask, ts.missing_bin,
                 max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
                 max_depth=cfg.max_depth, hist_method=self._hist_method(),
-                exact=cfg.tree_growth_mode == "exact")
+                exact=cfg.tree_growth_mode == "exact",
+                with_categorical=ts.has_categorical)
             tree, had_split = self._finalize_tree(tree, leaf_id, c)
             no_split = no_split and not had_split
             self._add_tree(tree, leaf_id, c)
+            self._bias_after_score(c, had_split)
         self.iter += 1
         return no_split
 
@@ -245,6 +257,28 @@ class GBDT:
         return np.asarray(self.train_score if self.num_tree_per_iteration == 1
                           else self.train_score[:, class_idx], dtype=np.float64)
 
+    def _bias_after_score(self, class_idx: int, had_split: bool) -> None:
+        """Fold the boost-from-average init score into the just-stored tree
+        AFTER the score update so scores are not double counted
+        (reference: gbdt.cpp:404-435 — AddBias after UpdateScore for split
+        trees; AsConstantTree(init) for a splitless first tree). RF overrides
+        (it folds its bias per-tree in _finalize_tree, rf.hpp:135-137)."""
+        first = len(self.trees) <= self.num_tree_per_iteration
+        bias = self.init_scores[class_idx] if (first and self._fold_init_bias) else 0.0
+        if abs(bias) <= 1e-15:
+            self.tree_bias.append(0.0)
+            return
+        tree = self.trees[-1]
+        if had_split:
+            tree = tree._replace(leaf_value=tree.leaf_value + bias,
+                                 node_value=tree.node_value + bias)
+        else:
+            tree = tree._replace(leaf_value=tree.leaf_value.at[0].set(bias))
+        self.trees[-1] = tree
+        self.host_trees[-1] = self._make_host_tree(tree)
+        self.tree_bias.append(bias)
+        self._stacked_cache = None
+
     def _add_tree(self, tree: TreeArrays, leaf_id: jax.Array, class_idx: int) -> None:
         """Score updates for train (via leaf ids — no traversal needed) and
         valid sets (tree traversal on their binned matrices)."""
@@ -263,20 +297,25 @@ class GBDT:
         self._append_host_tree(tree)
         self._stacked_cache = None
 
-    def _append_host_tree(self, tree: TreeArrays) -> None:
+    def _make_host_tree(self, tree: TreeArrays) -> HostTree:
         ds = self.train_set
         num_leaves = int(tree.num_leaves)
         n_nodes = max(num_leaves - 1, 0)
         feats = np.asarray(tree.node_feature[:n_nodes])
         bins_thr = np.asarray(tree.node_threshold_bin[:n_nodes])
         real_thr = np.zeros(n_nodes, dtype=np.float64)
+        missing = np.zeros(n_nodes, dtype=np.int8)
         used = ds.used_features
         for i in range(n_nodes):
             mapper = ds.mappers[used[feats[i]]]
             real_thr[i] = mapper.bin_to_value(int(bins_thr[i]))
+            missing[i] = mapper.missing_type
         full_thr = np.zeros(tree.node_threshold_bin.shape[0], dtype=np.float64)
         full_thr[:n_nodes] = real_thr
-        self.host_trees.append(HostTree(tree, full_thr, used))
+        return HostTree(tree, full_thr, used, missing)
+
+    def _append_host_tree(self, tree: TreeArrays) -> None:
+        self.host_trees.append(self._make_host_tree(tree))
 
     def rollback_one_iter(self) -> None:
         """reference: gbdt.cpp:454-470 RollbackOneIter."""
@@ -286,16 +325,19 @@ class GBDT:
         for c in range(k):
             tree = self.trees.pop()
             self.host_trees.pop()
+            bias = self.tree_bias.pop() if self.tree_bias else 0.0
             class_idx = k - 1 - c
-            # recompute train deltas via traversal (leaf ids not stored)
+            # recompute train deltas via traversal (leaf ids not stored);
+            # subtract only the pre-bias contribution (the init-score bias was
+            # folded AFTER the score update, see _bias_after_score)
             delta = predict_value_bins(tree, self.train_set.bins,
-                                       self.train_set.missing_bin)
+                                       self.train_set.missing_bin) - bias
             if k > 1:
                 self.train_score = self.train_score.at[:, class_idx].add(-delta)
             else:
                 self.train_score = self.train_score - delta
             for i, vs in enumerate(self.valid_sets):
-                vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin)
+                vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin) - bias
                 if k > 1:
                     self._valid_scores[i] = self._valid_scores[i].at[:, class_idx].add(-vdelta)
                 else:
@@ -340,6 +382,17 @@ class GBDT:
         return out
 
     # ---------------------------------------------------------- predict
+    def _prep_predict_X(self, X) -> np.ndarray:
+        """Predict-time feature matrix: pandas category columns are mapped
+        through the train-time category lists BEFORE any array conversion
+        (np.asarray on a category dtype would yield raw values, not codes)."""
+        from ..basic import _to_2d_float
+        X = self.train_set._pandas_to_codes(X)
+        X = _to_2d_float(X)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        return X
+
     def _stacked(self, num_iteration: Optional[int] = None) -> Optional[TreeArrays]:
         total_iters = len(self.trees) // self.num_tree_per_iteration
         use_iters = total_iters if num_iteration is None or num_iteration <= 0 \
@@ -356,23 +409,31 @@ class GBDT:
     def predict_raw(self, X, num_iteration: Optional[int] = None,
                     start_iteration: int = 0) -> np.ndarray:
         """Raw scores for new raw-feature data (binned via the train mappers;
-        the analog of GBDT::PredictRaw, gbdt_prediction.cpp:13-53)."""
+        the analog of GBDT::PredictRaw, gbdt_prediction.cpp:13-53). The
+        boost-from-average init score lives inside the first tree's leaves
+        (see _bias_after_score), so prediction is a pure sum of tree outputs.
+        Iterations from a loaded init model come first (gbdt.h
+        num_init_iteration_)."""
+        X = self._prep_predict_X(X)
         bins = jnp.asarray(self.train_set.bin_new_data(X))
         k = self.num_tree_per_iteration
         n = bins.shape[0]
-        total_iters = len(self.trees) // k
+        total_iters = self.loaded_iters + len(self.trees) // k
         # num_iteration counts iterations used FROM start_iteration
         # (reference: c_api predict semantics, gbdt.h num_iteration_for_pred_)
         if num_iteration is None or num_iteration <= 0:
             end_iter = total_iters
         else:
             end_iter = min(start_iteration + num_iteration, total_iters)
-        out = np.tile(np.asarray(self.init_scores, dtype=np.float64), (n, 1))
+        out = np.zeros((n, k), dtype=np.float64)
         mb = self.train_set.missing_bin
         for it in range(start_iteration, end_iter):
             for c in range(k):
-                tree = self.trees[it * k + c]
-                out[:, c] += np.asarray(predict_value_bins(tree, bins, mb))
+                if it < self.loaded_iters:
+                    out[:, c] += self.loaded.trees[it * k + c].predict(X)
+                else:
+                    tree = self.trees[(it - self.loaded_iters) * k + c]
+                    out[:, c] += np.asarray(predict_value_bins(tree, bins, mb))
         return out if k > 1 else out[:, 0]
 
     def predict(self, X, raw_score: bool = False,
@@ -387,9 +448,10 @@ class GBDT:
     def predict_leaf(self, X, num_iteration: Optional[int] = None,
                      start_iteration: int = 0) -> np.ndarray:
         """Per-tree leaf indices (reference: predict_leaf_index path)."""
+        X = self._prep_predict_X(X)
         bins = jnp.asarray(self.train_set.bin_new_data(X))
         k = self.num_tree_per_iteration
-        total_iters = len(self.trees) // k
+        total_iters = self.loaded_iters + len(self.trees) // k
         if num_iteration is None or num_iteration <= 0:
             end_iter = total_iters
         else:
@@ -398,15 +460,60 @@ class GBDT:
         cols = []
         for it in range(start_iteration, end_iter):
             for c in range(k):
-                cols.append(np.asarray(predict_leaf_bins(self.trees[it * k + c], bins, mb)))
+                if it < self.loaded_iters:
+                    cols.append(self.loaded.trees[it * k + c].leaf_index(X))
+                else:
+                    tree = self.trees[(it - self.loaded_iters) * k + c]
+                    cols.append(np.asarray(predict_leaf_bins(tree, bins, mb)))
         return np.stack(cols, axis=1) if cols else np.zeros((bins.shape[0], 0), np.int32)
+
+    def predict_contrib(self, X, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> np.ndarray:
+        """SHAP feature contributions (reference: GBDT::PredictContrib via
+        Tree::PredictContrib, tree.h:139; layout [N, (F+1)*k])."""
+        from ..io.model_text import ModelTree
+        from ..io.shap import predict_contrib_trees
+        X = self._prep_predict_X(X)
+        k = self.num_tree_per_iteration
+        total_iters = self.loaded_iters + len(self.trees) // k
+        if num_iteration is None or num_iteration <= 0:
+            end_iter = total_iters
+        else:
+            end_iter = min(start_iteration + num_iteration, total_iters)
+        mappers = self.train_set.mappers
+        trees = []
+        for it in range(start_iteration, end_iter):
+            for c in range(k):
+                if it < self.loaded_iters:
+                    trees.append(self.loaded.trees[it * k + c])
+                else:
+                    trees.append(ModelTree.from_host(
+                        self.host_trees[(it - self.loaded_iters) * k + c], mappers))
+        return predict_contrib_trees(trees, X,
+                                     self.train_set.num_total_features, k,
+                                     average=self.average_output)
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        """Split-count or total-gain importance per original feature
+        (reference: gbdt.cpp:838+ FeatureImportance)."""
+        imp = np.zeros(self.train_set.num_total_features, dtype=np.float64)
+        if self.loaded is not None:
+            imp += self.loaded.feature_importance(importance_type)
+        for ht in self.host_trees:
+            for i in range(ht.num_leaves - 1):
+                real_feat = int(ht.feature_indices[ht.split_feature[i]])
+                if importance_type == "split":
+                    imp[real_feat] += 1.0
+                else:
+                    imp[real_feat] += max(float(ht.split_gain[i]), 0.0)
+        return imp
 
     @property
     def num_trees(self) -> int:
-        return len(self.trees)
+        return len(self.trees) + self.loaded_iters * self.num_tree_per_iteration
 
     def current_iteration(self) -> int:
-        return self.iter
+        return self.iter + self.loaded_iters
 
 
 def _call_feval(feval, score_np, ds, objective, ds_name="valid"):

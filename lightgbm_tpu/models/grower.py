@@ -75,11 +75,17 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
     feat = best.feature[l]
     thr = best.threshold[l]
     dleft = best.default_left[l]
+    is_cat = best.is_cat[l]
+    bitset = best.cat_bitset[l]
 
     # --- rows of leaf l route left/right
     col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
     mb = missing_bin[feat]
-    go_left = jnp.where((col == mb) & (mb >= 0), dleft, col <= thr)
+    num_left = jnp.where((col == mb) & (mb >= 0), dleft, col <= thr)
+    # categorical: bitset membership (Tree::CategoricalDecision, tree.h:349)
+    word = jnp.take(bitset, col >> 5)
+    cat_left = ((word >> (col & 31).astype(jnp.uint32)) & 1) == 1
+    go_left = jnp.where(is_cat, cat_left, num_left)
     in_leaf = state.leaf_id == l
     leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
 
@@ -98,6 +104,8 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
         node_feature=tree.node_feature.at[node].set(feat),
         node_threshold_bin=tree.node_threshold_bin.at[node].set(thr),
         node_default_left=tree.node_default_left.at[node].set(dleft),
+        node_cat=tree.node_cat.at[node].set(is_cat),
+        node_cat_bitset=tree.node_cat_bitset.at[node].set(bitset),
         node_left=node_left.at[node].set(~l),
         node_right=node_right.at[node].set(~new_leaf),
         node_gain=tree.node_gain.at[node].set(best.gain[l]),
@@ -139,13 +147,14 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
 @functools.partial(
     jax.jit,
     static_argnames=("max_leaves", "num_bins", "max_depth", "hist_method",
-                     "exact", "axis_name"))
+                     "exact", "axis_name", "with_categorical"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
               feature_mask: jax.Array, missing_bin: jax.Array, *,
               max_leaves: int, num_bins: int, max_depth: int = -1,
               hist_method: str = "scatter",
               exact: bool = False,
+              with_categorical: bool = False,
               axis_name: str | None = None) -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree. Returns (tree arrays, per-row leaf index).
 
@@ -171,6 +180,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     """
     n, f = bins.shape
     L = max_leaves
+    cat_words = max(1, -(-num_bins // 32))
 
     stats = jnp.stack([grad * sample_mask, hess * sample_mask, sample_mask],
                       axis=1).astype(jnp.float32)
@@ -185,7 +195,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             jnp.zeros((L, f, num_bins, 3), jnp.float32),
             jnp.zeros((L,)), jnp.zeros((L,)), jnp.zeros((L,)), jnp.zeros((L,)),
             jnp.zeros((L,), jnp.int32), meta, params,
-            feature_mask, max_depth)
+            feature_mask, max_depth, with_categorical=False,
+            cat_words=cat_words)
         return GrowState(
             leaf_id=jnp.zeros((n,), jnp.int32),
             hist=jnp.zeros((L, f, num_bins, 3), jnp.float32),
@@ -197,7 +208,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             leaf_output=jnp.zeros((L,)).at[0].set(root_out),
             leaf_depth=jnp.zeros((L,), jnp.int32),
             best=zero_best,
-            tree=empty_tree(L),
+            tree=empty_tree(L, cat_words),
             num_leaves=jnp.int32(1),
             rounds=jnp.int32(0),
         )
@@ -230,7 +241,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         best = find_best_splits(hist, state.leaf_sum_g, state.leaf_sum_h,
                                 state.leaf_cnt, state.leaf_output,
                                 state.leaf_depth, meta, params,
-                                feature_mask, max_depth)
+                                feature_mask, max_depth,
+                                with_categorical=with_categorical,
+                                cat_words=cat_words)
         state = state._replace(hist=hist, hist_valid=hist_valid,
                                leaf_dead=leaf_dead, best=best,
                                rounds=state.rounds + 1)

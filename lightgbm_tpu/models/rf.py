@@ -99,10 +99,20 @@ class RF(GBDT):
                        ) -> Tuple[TreeArrays, bool]:
         tree, had_split = super()._finalize_tree(tree, leaf_id, class_idx)
         bias = self.init_scores[class_idx]
-        if had_split and abs(bias) > 1e-15:
-            tree = tree._replace(leaf_value=tree.leaf_value + bias,
-                                 node_value=tree.node_value + bias)
+        if abs(bias) > 1e-15:
+            if had_split:
+                tree = tree._replace(leaf_value=tree.leaf_value + bias,
+                                     node_value=tree.node_value + bias)
+            else:
+                # splitless tree becomes the constant init tree (rf.hpp:131
+                # AsConstantTree path)
+                tree = tree._replace(leaf_value=tree.leaf_value.at[0].set(bias))
         return tree, had_split
+
+    def _bias_after_score(self, class_idx: int, had_split: bool) -> None:
+        """RF folds its bias per-tree in _finalize_tree (BEFORE the running
+        mean update — the mean must include it); no post-score fold."""
+        self.tree_bias.append(0.0)
 
     def _add_tree(self, tree: TreeArrays, leaf_id, class_idx: int) -> None:
         """Running-mean score update (rf.hpp:139-141):
@@ -139,6 +149,8 @@ class RF(GBDT):
         for c in range(k):
             tree = self.trees.pop()
             self.host_trees.pop()
+            if self.tree_bias:
+                self.tree_bias.pop()
             class_idx = k - 1 - c
             delta = predict_value_bins(tree, self.train_set.bins,
                                        self.train_set.missing_bin)

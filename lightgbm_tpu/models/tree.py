@@ -153,7 +153,8 @@ class HostTree:
     raw-feature prediction. Built once per tree after training."""
 
     def __init__(self, arrays: TreeArrays, real_thresholds: np.ndarray,
-                 feature_indices: np.ndarray):
+                 feature_indices: np.ndarray,
+                 missing_types: np.ndarray | None = None):
         t = jax.tree.map(np.asarray, arrays)
         self.num_leaves = int(t.num_leaves)
         n = max(self.num_leaves - 1, 0)
@@ -177,6 +178,11 @@ class HostTree:
         self.shrinkage = float(t.shrinkage)
         # map inner feature index -> original column index
         self.feature_indices = feature_indices
+        # per-node missing type (binning.MISSING_*), for decision_type dumps
+        # (reference: tree.h:269 GetMissingType packed in decision_type_)
+        self.missing_type = (missing_types[:n].astype(np.int8)
+                             if missing_types is not None
+                             else np.zeros(n, dtype=np.int8))
 
     def scaled(self, factor: float) -> "HostTree":
         """Copy with outputs scaled (reference: Tree::Shrinkage, tree.h:187;

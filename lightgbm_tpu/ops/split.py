@@ -110,10 +110,11 @@ class SplitInfo(NamedTuple):
     right_count: jax.Array
     left_output: jax.Array
     right_output: jax.Array
+    is_cat: jax.Array        # bool, categorical (bitset) split
     cat_bitset: jax.Array    # uint32[L, CAT_WORDS] categorical membership (0 when numerical)
 
 
-CAT_BITSET_WORDS = 8  # supports categorical splits over up to 256 bins
+CAT_BITSET_WORDS = 8  # default width (256 bins); widened when max_bin > 256
 
 
 def threshold_l1(s: jax.Array, l1: jax.Array) -> jax.Array:
@@ -178,9 +179,219 @@ def _directional_sums(hist_excl, leaf_sum_g, leaf_sum_h, leaf_cnt):
     return lt
 
 
+def _leaf_gain_nosmooth(sum_g, sum_h, p: SplitParams, lambda_l2):
+    """Leaf gain with NO path smoothing (the reference's categorical
+    min_gain_shift when path_smooth is off, feature_histogram.hpp:296-302:
+    GetLeafGain with parent_output=0)."""
+    sg = threshold_l1(sum_g, p.lambda_l1)
+    out = -sg / (sum_h + lambda_l2)
+    out = jnp.where((p.max_delta_step > 0) & (jnp.abs(out) > p.max_delta_step),
+                    jnp.sign(out) * p.max_delta_step, out)
+    return -(2.0 * sg * out + (sum_h + lambda_l2) * out * out)
+
+
+def find_best_cat_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
+                         leaf_output, leaf_depth, meta: FeatureMeta,
+                         p: SplitParams, feature_mask: jax.Array,
+                         max_depth: int = -1,
+                         cat_words: int = CAT_BITSET_WORDS):
+    """Best categorical split per leaf over all categorical features.
+
+    Vectorized re-design of the reference's categorical threshold search
+    (reference: feature_histogram.hpp:277-515
+    FindBestThresholdCategoricalInner). Two modes, chosen per feature:
+
+    - one-hot (num_bin <= max_cat_to_onehot): every bin t in [1, nb) is a
+      one-vs-rest candidate, gain with plain lambda_l2.
+    - sorted many-vs-many: bins with count >= cat_smooth are sorted by
+      grad/(hess + cat_smooth); candidates take the first i+1 sorted bins
+      from either end (two directions), with l2 += cat_l2, the
+      min_data_per_group group counter, and max_cat_threshold cap.
+
+    Candidate axes are evaluated all at once as [L, F, 3, B] gains
+    (mode-slots: one-hot / dir+1 / dir-1); a lexicographic argmax reproduces
+    the reference's first-better-wins evaluation order.
+
+    Returns (gain[L], feature[L], left sums..., bitset[L, CAT_WORDS]).
+    """
+    L, F, B, _ = hist.shape
+    g = hist[..., 0]
+    h = hist[..., 1]
+    c = hist[..., 2]
+    nb = meta.num_bins[None, :]                                 # [1, F]
+    bins = jnp.arange(B, dtype=jnp.int32)[None, None, :]        # [1, 1, B]
+    in_range = (bins >= 1) & (bins < nb[:, :, None])            # bin 0 = other/NaN
+    G = leaf_sum_g[:, None]
+    H = leaf_sum_h[:, None]
+    C = leaf_cnt[:, None]
+    parent_out = leaf_output[:, None, None]
+
+    use_onehot = (meta.num_bins <= p.max_cat_to_onehot)[None, :]   # [1, F]
+    l2_sorted = p.lambda_l2 + p.cat_l2
+
+    # min_gain_shift (feature_histogram.hpp:291-305): smoothing uses the
+    # parent's actual output; otherwise plain-l2 leaf gain with no smoothing
+    use_smooth = p.path_smooth > K_EPSILON
+    shift_smooth = leaf_gain_given_output(leaf_sum_g, leaf_sum_h, leaf_output, p)
+    shift_plain = _leaf_gain_nosmooth(leaf_sum_g, leaf_sum_h, p, p.lambda_l2)
+    min_gain_shift = (jnp.where(use_smooth, shift_smooth, shift_plain)
+                      + p.min_gain_to_split)[:, None, None]       # [L, 1, 1]
+
+    def split_gain(lg, lh, lc, l2):
+        rg, rh, rc = G[:, :, None] - lg, H[:, :, None] - lh, C[:, :, None] - lc
+        lo = calculate_leaf_output(lg, lh, p, lc, parent_out, l2)
+        ro = calculate_leaf_output(rg, rh, p, rc, parent_out, l2)
+        return (leaf_gain_given_output(lg, lh, lo, p, l2)
+                + leaf_gain_given_output(rg, rh, ro, p, l2))
+
+    # ---- one-hot candidates: left = single bin t (hess + eps)
+    oh_lg, oh_lh, oh_lc = g, h + K_EPSILON, c
+    oh_gain = split_gain(oh_lg, oh_lh, oh_lc, p.lambda_l2)
+    oh_ok = (in_range
+             & (c >= p.min_data_in_leaf) & (h >= p.min_sum_hessian_in_leaf)
+             & (C[:, :, None] - c >= p.min_data_in_leaf)
+             & (H[:, :, None] - h - K_EPSILON >= p.min_sum_hessian_in_leaf))
+
+    # ---- sorted candidates
+    valid = in_range & (c >= p.cat_smooth)                       # count filter
+    ratio = jnp.where(valid, g / (h + p.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=2)                           # stable; invalid last
+    sg = jnp.take_along_axis(jnp.where(valid, g, 0.0), order, axis=2)
+    sh = jnp.take_along_axis(jnp.where(valid, h, 0.0), order, axis=2)
+    sc = jnp.take_along_axis(jnp.where(valid, c, 0.0), order, axis=2)
+    csum_g = jnp.cumsum(sg, axis=2)
+    csum_h = jnp.cumsum(sh, axis=2)
+    csum_c = jnp.cumsum(sc, axis=2)
+    used_bin = valid.sum(axis=2).astype(jnp.int32)               # [L, F]
+    max_num_cat = jnp.minimum(p.max_cat_threshold, (used_bin + 1) // 2)
+
+    idx = jnp.arange(B, dtype=jnp.int32)[None, None, :]
+    # dir +1: left = first i+1 sorted bins
+    fw_lg, fw_lh, fw_lc = csum_g, csum_h + K_EPSILON, csum_c
+    # dir -1: left = last i+1 valid sorted bins = total_valid - csum[ub-2-i]
+    j = used_bin[:, :, None] - 2 - idx
+    jc = jnp.clip(j, 0, B - 1)
+    tot_g, tot_h, tot_c = csum_g[:, :, -1:], csum_h[:, :, -1:], csum_c[:, :, -1:]
+    bw_lg = tot_g - jnp.where(j >= 0, jnp.take_along_axis(csum_g, jc, axis=2), 0.0)
+    bw_lh = tot_h - jnp.where(j >= 0, jnp.take_along_axis(csum_h, jc, axis=2), 0.0) + K_EPSILON
+    bw_lc = tot_c - jnp.where(j >= 0, jnp.take_along_axis(csum_c, jc, axis=2), 0.0)
+
+    cand_ok_base = (idx < used_bin[:, :, None]) & (idx < max_num_cat[:, :, None])
+
+    def sorted_guards(lh_, lc_):
+        rc = C[:, :, None] - lc_
+        rh = H[:, :, None] - lh_
+        return ((lc_ >= p.min_data_in_leaf) & (lh_ >= p.min_sum_hessian_in_leaf)
+                & (rc >= p.min_data_in_leaf) & (rc >= p.min_data_per_group)
+                & (rh >= p.min_sum_hessian_in_leaf))
+
+    # group counter (feature_histogram.hpp:443-447): cnt accumulates along the
+    # scan and resets when a candidate is emitted — a sequential recurrence,
+    # run as a lax.scan over the (small) bin axis with [L, F] lanes
+    def group_scan(per_bin_cnt, eligible):
+        def step(carry, xs):
+            cnt_i, elig_i = xs
+            acc = carry + cnt_i
+            emit = elig_i & (acc >= p.min_data_per_group)
+            return jnp.where(emit, 0.0, acc), emit
+        xs = (jnp.moveaxis(per_bin_cnt, 2, 0), jnp.moveaxis(eligible, 2, 0))
+        _, emits = jax.lax.scan(step, jnp.zeros(per_bin_cnt.shape[:2]), xs)
+        return jnp.moveaxis(emits, 0, 2)
+
+    fw_elig = cand_ok_base & sorted_guards(fw_lh, fw_lc)
+    bw_elig = cand_ok_base & sorted_guards(bw_lh, bw_lc)
+    # per-candidate cnt along each direction's scan order
+    bw_cnt = jnp.where(j + 1 >= 0,
+                       jnp.take_along_axis(sc, jnp.clip(j + 1, 0, B - 1), axis=2),
+                       0.0)
+    fw_ok = group_scan(sc, fw_elig)
+    bw_ok = group_scan(bw_cnt, bw_elig)
+
+    fw_gain = split_gain(fw_lg, fw_lh, fw_lc, l2_sorted)
+    bw_gain = split_gain(bw_lg, bw_lh, bw_lc, l2_sorted)
+
+    # ---- assemble [L, F, 3, B]: slot 0 one-hot, 1 dir+1, 2 dir-1
+    fmask = feature_mask
+    if fmask.ndim == 1:
+        fmask = fmask[None, :]
+    base_ok = (fmask.astype(bool) & meta.is_categorical)[:, :, None]  # [L|1, F, 1]
+    if max_depth > 0:
+        base_ok = base_ok & (leaf_depth < max_depth)[:, None, None]
+
+    oh_val = oh_ok & base_ok & use_onehot[:, :, None]
+    so_val = base_ok & ~use_onehot[:, :, None]
+    gains = jnp.stack([
+        jnp.where(oh_val & (oh_gain > min_gain_shift), oh_gain, K_MIN_SCORE),
+        jnp.where(so_val & fw_ok & (fw_gain > min_gain_shift), fw_gain, K_MIN_SCORE),
+        jnp.where(so_val & bw_ok & (bw_gain > min_gain_shift), bw_gain, K_MIN_SCORE),
+    ], axis=2)                                                   # [L, F, 3, B]
+
+    # lexicographic argmax: features in index order, then evaluation order
+    # (one-hot t asc | dir+1 i asc | dir-1 i asc), strict-greater-wins
+    farange = jnp.arange(F, dtype=jnp.int32)[None, :, None, None]
+    slot_pref = jnp.asarray([3 * B, 2 * B, B], jnp.int32)[None, None, :, None]
+    pref = ((F - 1) - farange) * (8 * B) + slot_pref - idx[:, :, None, :]
+    flat_gains = gains.reshape(L, -1)
+    best_gain = jnp.max(flat_gains, axis=1)
+    is_best = flat_gains == best_gain[:, None]
+    best_idx = jnp.argmax(jnp.where(
+        is_best, jnp.broadcast_to(pref, gains.shape).reshape(L, -1), -1), axis=1)
+
+    bf = (best_idx // (3 * B)).astype(jnp.int32)
+    rem = best_idx % (3 * B)
+    bmode = (rem // B).astype(jnp.int32)                         # 0/1/2
+    bi = (rem % B).astype(jnp.int32)
+
+    li = jnp.arange(L)
+
+    def pick3(a0, a1, a2):
+        v0 = a0[li, bf, bi]
+        v1 = a1[li, bf, bi]
+        v2 = a2[li, bf, bi]
+        return jnp.where(bmode == 0, v0, jnp.where(bmode == 1, v1, v2))
+
+    left_g = pick3(oh_lg, fw_lg, bw_lg)
+    left_h = pick3(oh_lh, fw_lh, bw_lh)
+    left_c = pick3(oh_lc, fw_lc, bw_lc)
+
+    # ---- membership bitset over bins for the chosen candidate
+    order_rows = order[li, bf]                                   # [L, B]
+    rank = jnp.argsort(order_rows, axis=1).astype(jnp.int32)     # bin -> sort pos
+    ub_rows = used_bin[li, bf][:, None]
+    bins_row = jnp.arange(B, dtype=jnp.int32)[None, :]
+    member_oh = bins_row == bi[:, None]
+    member_fw = rank <= bi[:, None]
+    member_bw = (rank >= ub_rows - 1 - bi[:, None]) & (rank < ub_rows)
+    member = jnp.where((bmode == 0)[:, None], member_oh,
+                       jnp.where((bmode == 1)[:, None], member_fw, member_bw))
+    # restrict to in-range bins of the chosen feature
+    nb_rows = meta.num_bins[bf][:, None]
+    member = member & (bins_row >= 1) & (bins_row < nb_rows)
+    pad = (-B) % 32
+    if pad:
+        member = jnp.pad(member, ((0, 0), (0, pad)))
+    mw = member.reshape(L, -1, 32).astype(jnp.uint32)
+    words = (mw << jnp.arange(32, dtype=jnp.uint32)[None, None, :]).sum(
+        axis=2, dtype=jnp.uint32)
+    nwords = words.shape[1]
+    if nwords < cat_words:
+        words = jnp.pad(words, ((0, 0), (0, cat_words - nwords)))
+    else:
+        assert nwords == cat_words, (
+            f"bitset width {nwords} exceeds cat_words={cat_words}")
+
+    l2_out = jnp.where(use_onehot[0, bf], p.lambda_l2, l2_sorted)
+    shift = min_gain_shift[:, 0, 0]
+    stored_gain = jnp.where(jnp.isfinite(best_gain), best_gain - shift, K_MIN_SCORE)
+    return (stored_gain.astype(jnp.float32), bf, left_g, left_h, left_c,
+            words, l2_out)
+
+
 def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
                      leaf_output, leaf_depth, meta: FeatureMeta, p: SplitParams,
-                     feature_mask: jax.Array, max_depth: int = -1) -> SplitInfo:
+                     feature_mask: jax.Array, max_depth: int = -1,
+                     with_categorical: bool = False,
+                     cat_words: int = CAT_BITSET_WORDS) -> SplitInfo:
     """Best split per leaf over all numerical features.
 
     Args:
@@ -300,7 +511,7 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
     shift = min_gain_shift[:, 0, 0]
     stored_gain = jnp.where(jnp.isfinite(best_gain), best_gain - shift, K_MIN_SCORE)
 
-    return SplitInfo(
+    num_info = SplitInfo(
         gain=stored_gain.astype(jnp.float32),
         feature=bf,
         threshold=bt,
@@ -308,5 +519,45 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
         left_sum_g=left_g, left_sum_h=left_h, left_count=left_c,
         right_sum_g=right_g, right_sum_h=right_h, right_count=right_c,
         left_output=left_out, right_output=right_out,
-        cat_bitset=jnp.zeros((L, CAT_BITSET_WORDS), dtype=jnp.uint32),
+        is_cat=jnp.zeros((L,), dtype=bool),
+        cat_bitset=jnp.zeros((L, cat_words), dtype=jnp.uint32),
+    )
+    if not with_categorical:
+        return num_info
+
+    (cgain, cfeat, clg, clh, clc, cbits, cl2) = find_best_cat_splits(
+        hist, leaf_sum_g, leaf_sum_h, leaf_cnt, leaf_output, leaf_depth,
+        meta, p, feature_mask, max_depth, cat_words)
+    crg = leaf_sum_g - clg
+    crh = leaf_sum_h - clh
+    crc = leaf_cnt - clc
+    clo = calculate_leaf_output(clg, clh, p, clc, leaf_output, cl2)
+    cro = calculate_leaf_output(crg, crh, p, crc, leaf_output, cl2)
+    # per-leaf choice between numerical and categorical bests; ties resolve
+    # to the lower feature index (the reference's in-order feature loop with
+    # strict operator>, serial_tree_learner.cpp:374-448)
+    take_cat = (cgain > num_info.gain) | (
+        (cgain == num_info.gain) & jnp.isfinite(cgain) & (cfeat < num_info.feature))
+
+    def sel(cv, nv):
+        cond = take_cat
+        while cond.ndim < cv.ndim:
+            cond = cond[..., None]
+        return jnp.where(cond, cv, nv)
+
+    return SplitInfo(
+        gain=sel(cgain, num_info.gain),
+        feature=sel(cfeat, num_info.feature),
+        threshold=sel(jnp.zeros((L,), jnp.int32), num_info.threshold),
+        default_left=sel(jnp.zeros((L,), bool), num_info.default_left),
+        left_sum_g=sel(clg, num_info.left_sum_g),
+        left_sum_h=sel(clh, num_info.left_sum_h),
+        left_count=sel(clc, num_info.left_count),
+        right_sum_g=sel(crg, num_info.right_sum_g),
+        right_sum_h=sel(crh, num_info.right_sum_h),
+        right_count=sel(crc, num_info.right_count),
+        left_output=sel(clo, num_info.left_output),
+        right_output=sel(cro, num_info.right_output),
+        is_cat=take_cat,
+        cat_bitset=sel(cbits, num_info.cat_bitset),
     )

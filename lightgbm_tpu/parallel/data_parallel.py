@@ -53,6 +53,7 @@ def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
                  max_leaves: int, num_bins: int, max_depth: int = -1,
                  hist_method: str = "scatter",
                  exact: bool = False,
+                 with_categorical: bool = False,
                  axis: str = "data") -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with rows sharded over ``mesh`` axis ``axis``.
 
@@ -72,7 +73,7 @@ def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
     grow = functools.partial(
         grow_tree, max_leaves=max_leaves, num_bins=num_bins,
         max_depth=max_depth, hist_method=hist_method, exact=exact,
-        axis_name=axis)
+        with_categorical=with_categorical, axis_name=axis)
 
     shard = jax.shard_map(
         grow, mesh=mesh,

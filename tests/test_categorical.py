@@ -1,0 +1,124 @@
+"""Categorical feature splits (one-hot and sorted many-vs-many modes).
+
+Mirrors the reference's categorical coverage
+(reference: tests/python_package_test/test_engine.py categorical tests;
+semantics from src/treelearner/feature_histogram.hpp:277-515)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _cat_problem(n=2000, levels=10, seed=0):
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, levels, size=n)
+    num = rng.normal(size=n)
+    y = (np.isin(cat, [1, 3, 7]).astype(float) * 2.0 - 1.0
+         + 0.3 * rng.normal(size=n) > 0).astype(float)
+    X = np.stack([cat.astype(float), num], axis=1)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 8, "min_data_in_leaf": 5,
+        "verbosity": -1, "min_data_per_group": 1, "cat_smooth": 1.0}
+
+
+@pytest.mark.parametrize("onehot", [4, 64])
+def test_categorical_signal_recovery(onehot):
+    """Both cat modes must find the {1,3,7}-vs-rest structure."""
+    from sklearn.metrics import roc_auc_score
+    X, y = _cat_problem()
+    params = dict(BASE, max_cat_to_onehot=onehot)
+    ds = lgb.Dataset(X, label=y, params=params, categorical_feature=[0],
+                     free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=20)
+    assert roc_auc_score(y, booster.predict(X)) > 0.99
+    # the categorical feature must actually be used
+    assert booster.feature_importance()[0] > 0
+
+
+def test_categorical_beats_numerical_treatment():
+    """Scattered category ids {1,3,7} cannot be separated by one numeric
+    threshold; categorical handling must win."""
+    from sklearn.metrics import roc_auc_score
+    X, y = _cat_problem()
+    params = dict(BASE, num_leaves=4)
+    ds_cat = lgb.Dataset(X, label=y, params=params, categorical_feature=[0],
+                         free_raw_data=False)
+    cat_auc = roc_auc_score(y, lgb.train(params, ds_cat,
+                                         num_boost_round=3).predict(X))
+    ds_num = lgb.Dataset(X, label=y, params=params, categorical_feature=[],
+                         free_raw_data=False)
+    num_auc = roc_auc_score(y, lgb.train(params, ds_num,
+                                         num_boost_round=3).predict(X))
+    assert cat_auc > num_auc
+
+
+def test_categorical_model_round_trip():
+    X, y = _cat_problem()
+    ds = lgb.Dataset(X, label=y, params=BASE, categorical_feature=[0],
+                     free_raw_data=False)
+    booster = lgb.train(BASE, ds, num_boost_round=10)
+    s = booster.model_to_string()
+    assert "cat_boundaries=" in s or "num_cat=1" in s
+    loaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(booster.predict(X, raw_score=True),
+                               loaded.predict(X, raw_score=True))
+
+
+def test_unseen_and_nan_categories_route_right():
+    """Unseen category values and NaN go to the non-membership side
+    (reference: CategoricalDecision, tree.h:349-360)."""
+    X, y = _cat_problem()
+    ds = lgb.Dataset(X, label=y, params=BASE, categorical_feature=[0],
+                     free_raw_data=False)
+    booster = lgb.train(BASE, ds, num_boost_round=5)
+    X_new = np.array([[99.0, 0.0], [np.nan, 0.0], [-5.0, 0.0]])
+    p_new = booster.predict(X_new, raw_score=True)
+    # all three must route identically (none is a member of any split set)
+    assert p_new[0] == p_new[1] == p_new[2]
+    loaded = lgb.Booster(model_str=booster.model_to_string())
+    np.testing.assert_allclose(p_new, loaded.predict(X_new, raw_score=True))
+
+
+def test_pandas_categorical_auto_detect():
+    pd = pytest.importorskip("pandas")
+    X, y = _cat_problem(n=800)
+    df = pd.DataFrame({"c": pd.Categorical(X[:, 0].astype(int)),
+                       "x": X[:, 1]})
+    ds = lgb.Dataset(df, label=y, params=BASE, free_raw_data=False)
+    booster = lgb.train(BASE, ds, num_boost_round=5)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, booster.predict(df)) > 0.9
+
+
+def test_categorical_contrib_sums():
+    X, y = _cat_problem()
+    ds = lgb.Dataset(X, label=y, params=BASE, categorical_feature=[0],
+                     free_raw_data=False)
+    booster = lgb.train(BASE, ds, num_boost_round=5)
+    contrib = booster.predict(X[:30], pred_contrib=True)
+    raw = booster.predict(X[:30], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6, atol=1e-6)
+
+
+def test_max_cat_threshold_limits_set_size():
+    X, y = _cat_problem(levels=30)
+    params = dict(BASE, max_cat_threshold=2, max_cat_to_onehot=1)
+    ds = lgb.Dataset(X, label=y, params=params, categorical_feature=[0],
+                     free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=3)
+    model = booster.dump_model()
+
+    def walk(node, sets):
+        if "split_feature" in node:
+            if node.get("decision_type") == "==":
+                sets.append(node["threshold"])
+            walk(node["left_child"], sets)
+            walk(node["right_child"], sets)
+        return sets
+
+    for ti in model["tree_info"]:
+        for thr in walk(ti["tree_structure"], []):
+            assert len(thr.split("||")) <= 2

@@ -1,0 +1,194 @@
+"""Model text save/load, JSON dump, SHAP contribs, continued training.
+
+Mirrors the reference's model-IO behavior tests (reference:
+tests/python_package_test/test_basic.py model string round trips,
+test_engine.py:623-714 continued training, :1011-1117 SHAP contribs)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_binary(n=600, f=6, seed=0, with_nan=True):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    if with_nan:
+        X[::7, 2] = np.nan
+    y = (X[:, 0] + 0.5 * np.nan_to_num(X[:, 2])
+         + 0.1 * rng.normal(size=n) > 0).astype(float)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+          "verbosity": -1}
+
+
+@pytest.fixture(scope="module")
+def trained():
+    X, y = _make_binary()
+    ds = lgb.Dataset(X, label=y, params=PARAMS, free_raw_data=False)
+    booster = lgb.train(PARAMS, ds, num_boost_round=10)
+    return X, y, booster
+
+
+def test_model_text_round_trip(trained):
+    X, y, booster = trained
+    p1 = booster.predict(X, raw_score=True)
+    s = booster.model_to_string()
+    assert s.startswith("tree\nversion=v3\n")
+    assert "end of trees" in s
+    loaded = lgb.Booster(model_str=s)
+    p2 = loaded.predict(X, raw_score=True)
+    np.testing.assert_allclose(p1, p2, rtol=0, atol=0)
+    # converted (sigmoid) predictions too
+    np.testing.assert_allclose(booster.predict(X), loaded.predict(X))
+
+
+def test_model_file_round_trip(tmp_path, trained):
+    X, y, booster = trained
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(booster.predict(X), loaded.predict(X))
+    assert loaded.num_trees() == booster.num_trees()
+    assert loaded.feature_name() == booster.feature_name()
+
+
+def test_model_string_reserialize_identical(trained):
+    """Dump -> load -> dump must be byte-stable (text fixpoint)."""
+    _, _, booster = trained
+    s1 = booster.model_to_string()
+    s2 = lgb.Booster(model_str=s1).model_to_string()
+    # header + trees identical; parameters block may echo differently
+    head1 = s1.split("feature_importances:")[0]
+    head2 = s2.split("feature_importances:")[0]
+    assert head1 == head2
+
+
+def test_json_dump(trained):
+    X, _, booster = trained
+    model = booster.dump_model()
+    assert model["version"] == "v3"
+    assert model["num_class"] == 1
+    assert len(model["tree_info"]) == booster.num_trees()
+    # json must be serializable and the root structure navigable
+    js = json.dumps(model)
+    root = model["tree_info"][0]["tree_structure"]
+    assert "split_feature" in root and "left_child" in root
+
+
+def test_num_iteration_predict_window(trained):
+    X, _, booster = trained
+    p_first5 = booster.predict(X, raw_score=True, num_iteration=5)
+    s = booster.model_to_string(num_iteration=5)
+    loaded = lgb.Booster(model_str=s)
+    assert loaded.num_trees() == 5
+    np.testing.assert_allclose(p_first5, loaded.predict(X, raw_score=True))
+
+
+def test_predict_contrib_sums_to_raw(trained):
+    """SHAP contract: contributions + bias column == raw prediction
+    (reference: test_engine.py:1011+)."""
+    X, _, booster = trained
+    contrib = booster.predict(X[:50], pred_contrib=True)
+    assert contrib.shape == (50, X.shape[1] + 1)
+    raw = booster.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6, atol=1e-6)
+
+
+def test_predict_contrib_multiclass():
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(300, 5))
+    y = np.abs(X[:, 0] + 0.3 * rng.normal(size=300)).astype(int) % 3
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=5)
+    contrib = booster.predict(X[:20], pred_contrib=True)
+    assert contrib.shape == (20, 3 * (5 + 1))
+    raw = booster.predict(X[:20], raw_score=True)
+    for c in range(3):
+        np.testing.assert_allclose(contrib[:, c * 6:(c + 1) * 6].sum(axis=1),
+                                   raw[:, c], rtol=1e-6, atol=1e-6)
+
+
+def test_continued_training(trained):
+    """init_model continues the ensemble (reference: test_engine.py:623-714)."""
+    X, y, booster = trained
+    ds2 = lgb.Dataset(X, label=y, params=PARAMS, free_raw_data=False)
+    cont = lgb.train(PARAMS, ds2, num_boost_round=5, init_model=booster)
+    assert cont.num_trees() == 15
+    assert cont.current_iteration() == 15
+    # the continued model must outperform (or match) the base on train data
+    from sklearn.metrics import log_loss
+    base_ll = log_loss(y, booster.predict(X))
+    cont_ll = log_loss(y, cont.predict(X))
+    assert cont_ll <= base_ll + 1e-6
+    # save/load of the combined model is exact
+    loaded = lgb.Booster(model_str=cont.model_to_string())
+    np.testing.assert_allclose(cont.predict(X, raw_score=True),
+                               loaded.predict(X, raw_score=True))
+
+
+def test_continued_training_from_file(tmp_path, trained):
+    X, y, booster = trained
+    path = str(tmp_path / "init.txt")
+    booster.save_model(path)
+    ds2 = lgb.Dataset(X, label=y, params=PARAMS, free_raw_data=False)
+    cont = lgb.train(PARAMS, ds2, num_boost_round=3, init_model=path)
+    assert cont.num_trees() == 13
+
+
+def test_multiclass_model_round_trip():
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(400, 5))
+    y = (np.abs(X[:, 0]) * 2 + np.abs(X[:, 1])).astype(int) % 3
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=4)
+    loaded = lgb.Booster(model_str=booster.model_to_string())
+    np.testing.assert_allclose(booster.predict(X), loaded.predict(X))
+    assert loaded._boosting.num_tree_per_iteration == 3
+
+
+def test_feature_importance(trained):
+    X, _, booster = trained
+    split_imp = booster.feature_importance("split")
+    gain_imp = booster.feature_importance("gain")
+    assert split_imp.sum() > 0
+    assert gain_imp.sum() > 0
+    assert split_imp.dtype == np.int32
+    # model text echoes the same split importances
+    s = booster.model_to_string()
+    section = s.split("feature_importances:")[1]
+    total = sum(int(line.split("=")[1]) for line in section.splitlines()
+                if "=" in line and not line.startswith("["))
+    assert total == split_imp.sum()
+
+
+def test_predict_leaf_index(trained):
+    X, _, booster = trained
+    leaves = booster.predict(X[:30], pred_leaf=True)
+    assert leaves.shape == (30, booster.num_trees())
+    assert leaves.min() >= 0
+    # loaded model produces identical leaf assignments
+    loaded = lgb.Booster(model_str=booster.model_to_string())
+    np.testing.assert_array_equal(leaves, loaded.predict(X[:30], pred_leaf=True))
+
+
+def test_rf_average_output_round_trip():
+    X, y = _make_binary(seed=5, with_nan=False)
+    params = {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+              "bagging_freq": 1, "bagging_fraction": 0.7,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=6)
+    s = booster.model_to_string()
+    assert "average_output" in s
+    loaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(booster.predict(X, raw_score=True),
+                               loaded.predict(X, raw_score=True), rtol=1e-6)

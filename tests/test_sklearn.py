@@ -1,0 +1,137 @@
+"""sklearn estimator API (reference: tests/python_package_test/test_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import LGBMClassifier, LGBMRanker, LGBMRegressor
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn.base import clone  # noqa: E402
+from sklearn.datasets import make_classification, make_regression  # noqa: E402
+from sklearn.metrics import r2_score, roc_auc_score  # noqa: E402
+
+
+def test_classifier_binary():
+    X, y = make_classification(n_samples=600, n_features=8, random_state=0)
+    clf = LGBMClassifier(n_estimators=15, num_leaves=15, min_child_samples=5)
+    clf.fit(X, y)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (600, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    assert roc_auc_score(y, proba[:, 1]) > 0.95
+    assert set(np.unique(clf.predict(X))) <= set(clf.classes_.tolist())
+    assert clf.n_classes_ == 2
+    assert clf.feature_importances_.sum() > 0
+
+
+def test_classifier_string_labels():
+    X, y = make_classification(n_samples=400, n_features=6, random_state=1)
+    ys = np.where(y > 0, "yes", "no")
+    clf = LGBMClassifier(n_estimators=8, min_child_samples=5).fit(X, ys)
+    assert list(clf.classes_) == ["no", "yes"]
+    preds = clf.predict(X)
+    assert set(preds) <= {"no", "yes"}
+    assert (preds == ys).mean() > 0.9
+
+
+def test_classifier_multiclass():
+    X, y = make_classification(n_samples=900, n_features=8, n_informative=6,
+                               n_classes=3, random_state=2)
+    clf = LGBMClassifier(n_estimators=10, min_child_samples=5).fit(X, y)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (900, 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    assert (clf.predict(X) == y).mean() > 0.85
+
+
+def test_regressor_with_early_stopping():
+    X, y = make_regression(n_samples=600, n_features=8, noise=5.0,
+                           random_state=1)
+    reg = LGBMRegressor(n_estimators=100, num_leaves=15)
+    reg.fit(X, y, eval_set=[(X[:300], y[:300])], early_stopping_rounds=5,
+            verbose=False)
+    assert r2_score(y, reg.predict(X)) > 0.8
+    assert "valid_0" in reg.evals_result_
+
+
+def test_regressor_score_api():
+    X, y = make_regression(n_samples=400, n_features=6, noise=2.0,
+                           random_state=3)
+    reg = LGBMRegressor(n_estimators=20).fit(X, y)
+    assert reg.score(X, y) > 0.8
+
+
+def test_param_mapping_aliases():
+    """sklearn names must reach the booster as canonical params."""
+    X, y = make_regression(n_samples=300, n_features=5, random_state=4)
+    reg = LGBMRegressor(n_estimators=5, reg_alpha=0.5, reg_lambda=0.7,
+                        min_child_samples=7, colsample_bytree=0.8,
+                        subsample=0.9, subsample_freq=1)
+    reg.fit(X, y)
+    cfg = reg.booster_._boosting.config
+    assert cfg.lambda_l1 == 0.5
+    assert cfg.lambda_l2 == 0.7
+    assert cfg.min_data_in_leaf == 7
+    assert cfg.feature_fraction == 0.8
+    assert cfg.bagging_fraction == 0.9
+
+
+def test_clone_and_get_params():
+    clf = LGBMClassifier(n_estimators=12, num_leaves=9, cat_smooth=5.0)
+    cloned = clone(clf)
+    assert cloned.n_estimators == 12
+    assert cloned.num_leaves == 9
+    assert cloned.get_params()["cat_smooth"] == 5.0
+
+
+def test_custom_objective_callable():
+    X, y = make_regression(n_samples=400, n_features=5, random_state=5)
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    reg = LGBMRegressor(n_estimators=20, objective=l2_obj).fit(X, y)
+    ref = LGBMRegressor(n_estimators=20).fit(X, y)
+    # custom L2 must track built-in L2 closely
+    assert r2_score(y, reg.predict(X, raw_score=True)) > 0.8
+
+
+def test_ranker():
+    rng = np.random.RandomState(3)
+    nq, qsize = 30, 10
+    X = rng.normal(size=(nq * qsize, 5))
+    rel = X[:, 0] + 0.5 * rng.normal(size=nq * qsize)
+    y = np.clip((rel * 2).astype(int) - int(rel.min()), 0, 4)
+    group = np.full(nq, qsize)
+    rk = LGBMRanker(n_estimators=10, min_child_samples=3)
+    rk.fit(X, y, group=group, eval_set=[(X, y)], eval_group=[group])
+    assert rk.predict(X).shape == (nq * qsize,)
+    # per-query ranking should correlate with relevance
+    from scipy.stats import spearmanr
+    rho = spearmanr(rk.predict(X), y).statistic
+    assert rho > 0.3
+
+
+def test_ranker_requires_group():
+    X = np.random.RandomState(0).normal(size=(50, 3))
+    y = np.zeros(50)
+    with pytest.raises(ValueError, match="group"):
+        LGBMRanker(n_estimators=2).fit(X, y)
+
+
+def test_not_fitted_errors():
+    from sklearn.exceptions import NotFittedError
+    clf = LGBMClassifier()
+    with pytest.raises(NotFittedError):
+        clf.predict(np.zeros((2, 3)))
+    with pytest.raises(NotFittedError):
+        _ = clf.feature_importances_
+
+
+def test_class_weight_balanced():
+    X, y = make_classification(n_samples=600, n_features=6, weights=[0.9, 0.1],
+                               random_state=6)
+    clf = LGBMClassifier(n_estimators=10, class_weight="balanced",
+                        min_child_samples=5).fit(X, y)
+    proba = clf.predict_proba(X)[:, 1]
+    assert roc_auc_score(y, proba) > 0.9
