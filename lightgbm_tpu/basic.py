@@ -208,13 +208,19 @@ class Dataset:
             if len(self.used_features) == 0:
                 log.warning("There are no meaningful features, as all feature values"
                             " are constant.")
-            self._build_feature_meta()
+            self._build_feature_meta(config)
 
         used = [self.mappers[j] for j in self.used_features]
         Xu = X[:, self.used_features] if len(self.used_features) else np.zeros((self.num_data, 0))
         bins_np = binning.bin_data(Xu, used)
         dtype = np.uint8 if self.max_num_bins <= 256 else np.int32
         self.bins = jnp.asarray(bins_np.astype(dtype))
+        # raw feature retention for linear trees (reference: dataset.h:720
+        # raw_data_, kept when linear_tree so leaves can fit linear models)
+        keep_raw = config.linear_tree or (
+            self.reference is not None
+            and getattr(self.reference, "raw_data_np", None) is not None)
+        self.raw_data_np = X.astype(np.float32) if keep_raw else None
         self._constructed = True
         if self.free_raw_data:
             self.data = None
@@ -224,7 +230,7 @@ class Dataset:
                  f"number of used features: {len(self.used_features)}")
         return self
 
-    def _build_feature_meta(self):
+    def _build_feature_meta(self, config: Config):
         used = [self.mappers[j] for j in self.used_features]
         nb = np.array([m.num_bin for m in used], dtype=np.int32)
         self.max_num_bins = int(nb.max()) if len(nb) else 2
@@ -239,13 +245,33 @@ class Dataset:
                                         default_bin, -1)).astype(np.int32)
         self.has_categorical = bool(is_cat.any())
         f = max(len(used), 1)
+        # per-feature monotone direction and contri multiplier, mapped from
+        # ORIGINAL feature indices to used-feature space (reference:
+        # feature_histogram.hpp:1170-1177 FeatureMetainfo init)
+        monotone = np.zeros((f,), dtype=np.int8)
+        mc = list(config.monotone_constraints or [])
+        if mc and len(mc) != self.num_total_features:
+            log.fatal(f"monotone_constraints should be the same size as "
+                      f"feature number ({self.num_total_features}), "
+                      f"got {len(mc)}")
+        for i, j in enumerate(self.used_features):
+            if j < len(mc):
+                monotone[i] = np.int8(mc[j])
+        penalty = np.ones((f,), dtype=np.float32)
+        fc = list(config.feature_contri or [])
+        if fc and len(fc) != self.num_total_features:
+            log.fatal(f"feature_contri should be the same size as feature "
+                      f"number ({self.num_total_features}), got {len(fc)}")
+        for i, j in enumerate(self.used_features):
+            if j < len(fc):
+                penalty[i] = np.float32(fc[j])
         self._feature_meta = FeatureMeta(
             num_bins=jnp.asarray(nb if len(nb) else np.array([2], np.int32)),
             missing_type=jnp.asarray(missing if len(missing) else np.zeros(1, np.int32)),
             default_bin=jnp.asarray(default_bin if len(default_bin) else np.zeros(1, np.int32)),
             is_categorical=jnp.asarray(is_cat if len(is_cat) else np.zeros(1, bool)),
-            monotone=jnp.zeros((f,), dtype=jnp.int8),
-            penalty=jnp.ones((f,), dtype=jnp.float32),
+            monotone=jnp.asarray(monotone),
+            penalty=jnp.asarray(penalty),
         )
         self._missing_bin = jnp.asarray(missing_bin if len(missing_bin)
                                         else np.full(1, -1, np.int32))

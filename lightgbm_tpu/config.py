@@ -371,6 +371,14 @@ def _coerce(cfg: Config, key: str, value: Any) -> Any:
         elif isinstance(value, (list, tuple)):
             value = list(value)
         return value
+    if key == "interaction_constraints":
+        # string form "[0,1],[2,3]" (reference: config.cpp
+        # Config::Str2FeatureVec interaction parsing)
+        if isinstance(value, str):
+            import re
+            return [[int(x) for x in grp.split(",") if x.strip()]
+                    for grp in re.findall(r"\[([^\]]*)\]", value)]
+        return [list(map(int, grp)) for grp in value]
     if key in ("valid", "label_gain", "eval_at", "monotone_constraints", "feature_contri",
                "max_bin_by_feature", "auc_mu_weights", "cegb_penalty_feature_lazy",
                "cegb_penalty_feature_coupled"):

@@ -120,6 +120,11 @@ class ModelTree:
             t.decision_type[i] = dt
         t.cat_boundaries = np.asarray(cat_boundaries, np.int32)
         t.cat_threshold = np.asarray(cat_words, np.uint32)
+        if getattr(ht, "is_linear", False):
+            t.is_linear = True
+            t.leaf_const = np.asarray(ht.leaf_const, np.float64)
+            t.leaf_coeff = [list(map(float, c)) for c in ht.leaf_coeff]
+            t.leaf_features = [list(map(int, fs)) for fs in ht.leaf_features_raw]
         return t
 
     # -------------------------------------------------------------- text

@@ -58,6 +58,7 @@ class GBDT:
         # (reference: gbdt.h num_init_iteration_, engine.py:163-169)
         self.loaded = None
         self.loaded_iters = 0
+        self._mt_cache: Dict[int, object] = {}   # host-tree idx -> ModelTree
         self._stacked_cache: Optional[Tuple[int, TreeArrays]] = None
         self.valid_sets: List[Dataset] = []
         self.valid_names: List[str] = []
@@ -71,10 +72,13 @@ class GBDT:
     def _init_train(self, train_set: Dataset) -> None:
         train_set.construct()
         cfg = self.config
-        if cfg.monotone_constraints:
-            log.warning("monotone_constraints are not implemented yet and will be ignored")
-        if cfg.feature_contri:
-            log.warning("feature_contri is not implemented yet and will be ignored")
+        self._setup_learner_features(train_set)
+        if cfg.linear_tree and self.name in ("dart", "rf"):
+            log.fatal(f"linear_tree is not supported with boosting={self.name}")
+        if cfg.linear_tree and train_set.raw_data_np is None:
+            log.fatal("linear_tree requires the Dataset's raw data: construct "
+                      "the Dataset with linear_tree in its params (a Dataset "
+                      "constructed without it did not retain raw features)")
         if self.objective is None:
             self.objective = create_objective(cfg)
         label = train_set.get_label()
@@ -82,6 +86,9 @@ class GBDT:
         if self.objective is not None:
             self.objective.init(label, weight, train_set.get_group())
             self.num_tree_per_iteration = self.objective.num_model_per_iteration
+            if cfg.linear_tree and self.objective.need_renew_tree_output:
+                log.fatal(f"objective {cfg.objective} is not supported with "
+                          f"linear_tree")
         else:
             self.num_tree_per_iteration = max(cfg.num_class, 1)
         n = train_set.num_data
@@ -119,12 +126,71 @@ class GBDT:
         self._need_bagging = (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0) or \
             (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
 
+    def _setup_learner_features(self, train_set: Dataset) -> None:
+        """Static learner-feature flags + arrays for the grower (monotone,
+        interaction constraints, CEGB, extra-trees, per-node sampling)."""
+        cfg = self.config
+        f = train_set.num_used_features()
+        used = train_set.used_features
+        self._with_monotone = any(int(m) != 0
+                                  for m in (cfg.monotone_constraints or []))
+        if self._with_monotone and cfg.monotone_constraints_method not in (
+                "basic",):
+            log.warning(f"monotone_constraints_method="
+                        f"{cfg.monotone_constraints_method} is not implemented;"
+                        f" falling back to basic")
+        self._with_interactions = bool(cfg.interaction_constraints)
+        self._interaction_groups = None
+        if self._with_interactions:
+            orig_to_used = {int(j): i for i, j in enumerate(used)}
+            groups = np.zeros((len(cfg.interaction_constraints), f), bool)
+            for gi, grp in enumerate(cfg.interaction_constraints):
+                for j in grp:
+                    if int(j) in orig_to_used:
+                        groups[gi, orig_to_used[int(j)]] = True
+            self._interaction_groups = jnp.asarray(groups)
+        # CEGB enable rule (cost_effective_gradient_boosting.hpp:26-33)
+        cegb_enabled = (cfg.cegb_tradeoff < 1.0 or cfg.cegb_penalty_split > 0.0
+                        or cfg.cegb_penalty_feature_coupled
+                        or cfg.cegb_penalty_feature_lazy)
+        self._cegb_mode = "off"
+        self._cegb_coupled = None
+        self._cegb_lazy = None
+        # cross-iteration CEGB tracking survives reset_config (the reference
+        # Init() keeps its state once init_ is true)
+        self._cegb_aux = getattr(self, "_cegb_aux", None)
+        if cegb_enabled:
+            for name, lst in (("cegb_penalty_feature_coupled",
+                               cfg.cegb_penalty_feature_coupled),
+                              ("cegb_penalty_feature_lazy",
+                               cfg.cegb_penalty_feature_lazy)):
+                if lst and len(lst) != train_set.num_total_features:
+                    log.fatal(f"{name} should be the same size as feature "
+                              f"number ({train_set.num_total_features})")
+            self._cegb_mode = "lazy" if cfg.cegb_penalty_feature_lazy else "feat"
+            if cfg.cegb_penalty_feature_coupled:
+                arr = np.zeros((f,), np.float32)
+                for i, j in enumerate(used):
+                    if j < len(cfg.cegb_penalty_feature_coupled):
+                        arr[i] = cfg.cegb_penalty_feature_coupled[j]
+                self._cegb_coupled = jnp.asarray(arr)
+            if cfg.cegb_penalty_feature_lazy:
+                arr = np.zeros((f,), np.float32)
+                for i, j in enumerate(used):
+                    if j < len(cfg.cegb_penalty_feature_lazy):
+                        arr[i] = cfg.cegb_penalty_feature_lazy[j]
+                self._cegb_lazy = jnp.asarray(arr)
+        self._use_bynode = cfg.feature_fraction_bynode < 1.0
+        self._extra_rng_key = jax.random.PRNGKey(cfg.extra_seed)
+
     def reset_config(self, config: Config) -> None:
         """Apply updated parameters mid-training (reference: GBDT::ResetConfig,
         gbdt.cpp; used by the reset_parameter callback / learning_rates)."""
         self.config = config
         self.shrinkage_rate = config.learning_rate
         self.split_params = SplitParams.from_config(config)
+        if self.train_set is not None:
+            self._setup_learner_features(self.train_set)
         self._need_bagging = (config.bagging_freq > 0 and config.bagging_fraction < 1.0) or \
             (config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0)
 
@@ -208,16 +274,43 @@ class GBDT:
             gc = g[:, c] if k > 1 else g
             hc = h[:, c] if k > 1 else h
             fmask = self._feature_mask()
-            tree, leaf_id = grow_tree(
+            tree, leaf_id, aux = grow_tree(
                 ts.bins, gc, hc, mask,
                 ts.feature_meta, self.split_params, fmask, ts.missing_bin,
                 max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
                 max_depth=cfg.max_depth, hist_method=self._hist_method(),
                 exact=cfg.tree_growth_mode == "exact",
-                with_categorical=ts.has_categorical)
+                with_categorical=ts.has_categorical,
+                with_monotone=self._with_monotone,
+                with_interactions=self._with_interactions,
+                interaction_groups=self._interaction_groups,
+                cegb_mode=self._cegb_mode,
+                cegb_coupled=self._cegb_coupled,
+                cegb_lazy_penalty=self._cegb_lazy,
+                cegb_state=self._cegb_aux,
+                extra_trees=cfg.extra_trees,
+                use_bynode=self._use_bynode,
+                bynode_fraction=jnp.float32(cfg.feature_fraction_bynode)
+                if self._use_bynode else None,
+                rng_key=jax.random.fold_in(self._extra_rng_key,
+                                           self.iter * k + c))
+            if self._cegb_mode != "off":
+                # CEGB feature-used tracking persists across iterations
+                # (cost_effective_gradient_boosting.hpp Init: !init_ reuse)
+                self._cegb_aux = aux
+            lin = None
+            if cfg.linear_tree:
+                # "first tree" counts loaded init-model trees too
+                # (reference: models_.size() < num_tree_per_iteration_)
+                first_tree = len(self.trees) < k and self.loaded_iters == 0
+                lin = self._fit_linear_leaves(tree, leaf_id, gc, hc, mask,
+                                              first_tree)
             tree, had_split = self._finalize_tree(tree, leaf_id, c)
             no_split = no_split and not had_split
-            self._add_tree(tree, leaf_id, c)
+            if lin is not None:
+                self._add_tree(tree, leaf_id, c, linear=lin)
+            else:
+                self._add_tree(tree, leaf_id, c)
             self._bias_after_score(c, had_split)
         self.iter += 1
         return no_split
@@ -275,27 +368,141 @@ class GBDT:
         else:
             tree = tree._replace(leaf_value=tree.leaf_value.at[0].set(bias))
         self.trees[-1] = tree
-        self.host_trees[-1] = self._make_host_tree(tree)
+        old_ht = self.host_trees[-1]
+        new_ht = self._make_host_tree(tree)
+        if getattr(old_ht, "is_linear", False):
+            # AddBias reaches leaf_const too for linear trees (tree.h:212-231)
+            new_ht.is_linear = True
+            new_ht.leaf_const = old_ht.leaf_const + bias
+            new_ht.leaf_coeff = old_ht.leaf_coeff
+            new_ht.leaf_features_raw = old_ht.leaf_features_raw
+        self.host_trees[-1] = new_ht
+        self._mt_cache.pop(len(self.host_trees) - 1, None)
         self.tree_bias.append(bias)
         self._stacked_cache = None
 
-    def _add_tree(self, tree: TreeArrays, leaf_id: jax.Array, class_idx: int) -> None:
+    def _add_tree(self, tree: TreeArrays, leaf_id: jax.Array, class_idx: int,
+                  linear: Optional[dict] = None) -> None:
         """Score updates for train (via leaf ids — no traversal needed) and
-        valid sets (tree traversal on their binned matrices)."""
-        delta = tree.leaf_value[leaf_id]
+        valid sets (tree traversal on their binned matrices). ``linear``
+        carries a fitted linear-leaf model: per-row train deltas plus the
+        const/coeff tables (reference: Tree::AddPredictionToScore linear
+        branch, tree.h)."""
+        lr = self.shrinkage_rate
+        if linear is not None:
+            delta = jnp.asarray(linear["train_delta"] * lr)
+        else:
+            delta = tree.leaf_value[leaf_id]
         if self.num_tree_per_iteration > 1:
             self.train_score = self.train_score.at[:, class_idx].add(delta)
         else:
             self.train_score = self.train_score + delta
+        self.trees.append(tree)
+        self._append_host_tree(tree)
+        if linear is not None:
+            ht = self.host_trees[-1]
+            ht.is_linear = True
+            ht.leaf_const = linear["const"] * lr
+            ht.leaf_coeff = [[c * lr for c in cs] for cs in linear["coeff"]]
+            ht.leaf_features_raw = linear["features"]
+        mt = None
+        if linear is not None and self.valid_sets:
+            from ..io.model_text import ModelTree
+            mt = ModelTree.from_host(self.host_trees[-1],
+                                     self.train_set.mappers)
         for i, vs in enumerate(self.valid_sets):
-            vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin)
+            if mt is not None:
+                vdelta = jnp.asarray(mt.predict(vs.raw_data_np).astype(np.float32))
+            else:
+                vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin)
             if self.num_tree_per_iteration > 1:
                 self._valid_scores[i] = self._valid_scores[i].at[:, class_idx].add(vdelta)
             else:
                 self._valid_scores[i] = self._valid_scores[i] + vdelta
-        self.trees.append(tree)
-        self._append_host_tree(tree)
         self._stacked_cache = None
+
+    def _fit_linear_leaves(self, tree: TreeArrays, leaf_id: jax.Array,
+                           grad: jax.Array, hess: jax.Array, mask: jax.Array,
+                           first_tree: bool) -> dict:
+        """Fit a linear model per leaf on the raw branch features
+        (reference: linear_tree_learner.cpp:173-380 CalculateLinear —
+        coefficients = -(X^T H X + lambda)^{-1} X^T g per Eq 3 of
+        arXiv:1802.05640, with NaN rows excluded and near-zero coefficients
+        dropped). Returns pre-shrinkage const/coeff tables and per-row
+        train deltas."""
+        ts = self.train_set
+        raw = ts.raw_data_np
+        ht = self._make_host_tree(tree)
+        L = ht.num_leaves
+        leaf_np = np.asarray(leaf_id)
+        g = np.asarray(grad, np.float64)
+        h = np.asarray(hess, np.float64)
+        m = np.asarray(mask) > 0
+        lam = self.config.linear_lambda
+        from ..binning import BIN_TYPE_NUMERICAL, K_ZERO_THRESHOLD
+
+        # branch features per leaf (sorted unique numerical ORIGINAL indices,
+        # linear_tree_learner.cpp:195-225)
+        leaf_feats: List[List[int]] = [[] for _ in range(L)]
+        if L > 1:
+            stack = [(0, [])]
+            while stack:
+                node, path = stack.pop()
+                inner = int(ht.split_feature[node])
+                orig = int(ht.feature_indices[inner])
+                is_num = (ts.mappers[orig].bin_type == BIN_TYPE_NUMERICAL)
+                npath = path + ([orig] if is_num else [])
+                for child in (int(ht.left_child[node]), int(ht.right_child[node])):
+                    if child >= 0:
+                        stack.append((child, npath))
+                    else:
+                        leaf_feats[~child] = sorted(set(npath))
+
+        leaf_value = np.asarray(ht.leaf_value[:L], np.float64)
+        consts = leaf_value.copy()
+        coeffs: List[List[float]] = [[] for _ in range(L)]
+        features: List[List[int]] = [[] for _ in range(L)]
+        train_delta = leaf_value[leaf_np]
+
+        if not first_tree:
+            for leaf in range(L):
+                feats = leaf_feats[leaf]
+                if not feats:
+                    continue
+                rows = (leaf_np == leaf) & m
+                Xl = raw[rows][:, feats].astype(np.float64)
+                okr = ~np.isnan(Xl).any(axis=1) & ~np.isinf(Xl).any(axis=1)
+                if okr.sum() < len(feats) + 1:
+                    continue    # keep the plain leaf output as const
+                Xl = Xl[okr]
+                gl = g[rows][okr]
+                hl = h[rows][okr]
+                X1 = np.concatenate([Xl, np.ones((len(Xl), 1))], axis=1)
+                A = X1.T @ (X1 * hl[:, None])
+                A[np.arange(len(feats)), np.arange(len(feats))] += lam
+                b = X1.T @ gl
+                try:
+                    sol = -np.linalg.solve(A, b)
+                except np.linalg.LinAlgError:
+                    sol = -(np.linalg.pinv(A) @ b)
+                keep = [i for i in range(len(feats))
+                        if abs(sol[i]) > K_ZERO_THRESHOLD]
+                features[leaf] = [feats[i] for i in keep]
+                coeffs[leaf] = [float(sol[i]) for i in keep]
+                consts[leaf] = float(sol[-1])
+                # per-row deltas for rows of this leaf (NaN rows keep the
+                # plain leaf output, linear_tree_learner.cpp:19-41 semantics)
+                all_rows = leaf_np == leaf
+                Xa = raw[all_rows][:, features[leaf]].astype(np.float64) \
+                    if features[leaf] else np.zeros((int(all_rows.sum()), 0))
+                bad = (np.isnan(Xa).any(axis=1) | np.isinf(Xa).any(axis=1)) \
+                    if features[leaf] else np.zeros(int(all_rows.sum()), bool)
+                pred = consts[leaf] + (Xa @ np.asarray(coeffs[leaf])
+                                       if features[leaf] else 0.0)
+                train_delta[all_rows] = np.where(bad, leaf_value[leaf], pred)
+
+        return {"const": consts, "coeff": coeffs, "features": features,
+                "train_delta": train_delta.astype(np.float32)}
 
     def _make_host_tree(self, tree: TreeArrays) -> HostTree:
         ds = self.train_set
@@ -325,6 +532,7 @@ class GBDT:
         for c in range(k):
             tree = self.trees.pop()
             self.host_trees.pop()
+            self._mt_cache.pop(len(self.host_trees), None)
             bias = self.tree_bias.pop() if self.tree_bias else 0.0
             class_idx = k - 1 - c
             # recompute train deltas via traversal (leaf ids not stored);
@@ -415,6 +623,29 @@ class GBDT:
         Iterations from a loaded init model come first (gbdt.h
         num_init_iteration_)."""
         X = self._prep_predict_X(X)
+        if self.config.linear_tree:
+            # linear leaves predict on raw features via the model-space trees
+            from ..io.model_text import ModelTree
+            k = self.num_tree_per_iteration
+            total_iters = self.loaded_iters + len(self.trees) // k
+            if num_iteration is None or num_iteration <= 0:
+                end_iter = total_iters
+            else:
+                end_iter = min(start_iteration + num_iteration, total_iters)
+            out = np.zeros((X.shape[0], k), dtype=np.float64)
+            for it in range(start_iteration, end_iter):
+                for c in range(k):
+                    if it < self.loaded_iters:
+                        out[:, c] += self.loaded.trees[it * k + c].predict(X)
+                    else:
+                        idx = (it - self.loaded_iters) * k + c
+                        mt = self._mt_cache.get(idx)
+                        if mt is None:
+                            mt = ModelTree.from_host(self.host_trees[idx],
+                                                     self.train_set.mappers)
+                            self._mt_cache[idx] = mt
+                        out[:, c] += mt.predict(X)
+            return out if k > 1 else out[:, 0]
         bins = jnp.asarray(self.train_set.bin_new_data(X))
         k = self.num_tree_per_iteration
         n = bins.shape[0]

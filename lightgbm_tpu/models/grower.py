@@ -28,6 +28,21 @@ round's single pass).
 Guards mirror BeforeFindBestSplit (serial_tree_learner.cpp:282-322): a leaf
 whose count < 2*min_data_in_leaf or hessian sum < 2*min_sum_hessian_in_leaf
 is never histogrammed; max_depth masks at split-search level.
+
+Optional learner features threaded through the same jitted program:
+
+- monotone constraints, basic mode (monotone_constraints.hpp:463-512
+  BasicLeafConstraints): per-leaf [min, max] output bounds, updated with the
+  children's mid-point at every split on a monotone feature;
+- interaction constraints (col_sampler.hpp:20-50): per-leaf allowed-feature
+  masks derived from the features used along the path and the constraint
+  groups — two boolean matmuls per round;
+- CEGB (cost_effective_gradient_boosting.hpp): split/coupled/lazy penalties
+  as a per-(leaf, feature) additive gain adjustment;
+- extra_trees (feature_histogram.hpp USE_RAND): one random threshold per
+  (leaf, feature) per round;
+- feature_fraction_bynode (col_sampler.hpp GetByNode): per-leaf random
+  feature subset resampled every round.
 """
 
 from __future__ import annotations
@@ -40,10 +55,19 @@ import jax.numpy as jnp
 
 from ..ops.histogram import build_histograms
 from ..ops.split import (FeatureMeta, SplitInfo, SplitParams,
-                         calculate_leaf_output, find_best_splits)
+                         find_best_splits)
 from .tree import TreeArrays, empty_tree
 
 NEG_INF = -jnp.inf
+F32_MAX = jnp.finfo(jnp.float32).max
+
+
+class GrowAux(NamedTuple):
+    """Cross-iteration learner state returned alongside the tree (CEGB's
+    feature-used tracking is global across the boosting run,
+    cost_effective_gradient_boosting.hpp:90-101)."""
+    used_split: jax.Array    # [F] bool: feature used in any split (CEGB coupled)
+    row_used: jax.Array      # [N, F] bool or [1, 1] dummy (CEGB lazy)
 
 
 class GrowState(NamedTuple):
@@ -56,6 +80,11 @@ class GrowState(NamedTuple):
     leaf_cnt: jax.Array
     leaf_output: jax.Array
     leaf_depth: jax.Array    # [L] int32
+    leaf_min: jax.Array      # [L] monotone output lower bound
+    leaf_max: jax.Array      # [L] monotone output upper bound
+    used_path: jax.Array     # [L, F] bool (interaction constraints) or [1,1]
+    used_split: jax.Array    # [F] bool (CEGB coupled)
+    row_used: jax.Array      # [N, F] bool (CEGB lazy) or [1,1]
     best: SplitInfo
     tree: TreeArrays
     num_leaves: jax.Array    # int32
@@ -63,7 +92,9 @@ class GrowState(NamedTuple):
 
 
 def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
-                 gain_eff: jax.Array) -> Tuple[GrowState, jax.Array]:
+                 gain_eff: jax.Array, meta: FeatureMeta, *,
+                 with_monotone: bool, with_interactions: bool,
+                 cegb_lazy: bool) -> Tuple[GrowState, jax.Array]:
     """Split the current best leaf (reference: SerialTreeLearner::Split,
     serial_tree_learner.cpp:564-682 + Tree::Split, tree.h:62)."""
     l = jnp.argmax(gain_eff).astype(jnp.int32)
@@ -124,6 +155,36 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
     )
 
     new_depth = state.leaf_depth[l] + 1
+
+    # monotone basic-mode bound update (monotone_constraints.hpp:485-501):
+    # children inherit the parent's bounds; a split on a monotone feature
+    # tightens them around the children's mid-point
+    leaf_min, leaf_max = state.leaf_min, state.leaf_max
+    if with_monotone:
+        mono = meta.monotone[feat].astype(jnp.int32)
+        mono = jnp.where(is_cat, 0, mono)
+        mid = (best.left_output[l] + best.right_output[l]) / 2.0
+        pmin, pmax = leaf_min[l], leaf_max[l]
+        # leaf keeps the LEFT child, new_leaf the RIGHT child
+        lmax = jnp.where(mono > 0, jnp.minimum(pmax, mid), pmax)
+        lmin = jnp.where(mono < 0, jnp.maximum(pmin, mid), pmin)
+        rmin = jnp.where(mono > 0, jnp.maximum(pmin, mid), pmin)
+        rmax = jnp.where(mono < 0, jnp.minimum(pmax, mid), pmax)
+        leaf_min = leaf_min.at[l].set(lmin).at[new_leaf].set(rmin)
+        leaf_max = leaf_max.at[l].set(lmax).at[new_leaf].set(rmax)
+
+    used_path = state.used_path
+    if with_interactions:
+        parent_used = state.used_path[l].at[feat].set(True)
+        used_path = used_path.at[l].set(parent_used).at[new_leaf].set(parent_used)
+
+    used_split = state.used_split.at[feat].set(True)
+
+    row_used = state.row_used
+    if cegb_lazy:
+        row_used = row_used | (in_leaf[:, None]
+                               & (jnp.arange(row_used.shape[1]) == feat)[None, :])
+
     state = state._replace(
         leaf_id=leaf_id,
         tree=tree,
@@ -138,6 +199,8 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
                                      .at[new_leaf].set(best.right_output[l]),
         leaf_depth=state.leaf_depth.at[l].set(new_depth)
                                    .at[new_leaf].set(new_depth),
+        leaf_min=leaf_min, leaf_max=leaf_max,
+        used_path=used_path, used_split=used_split, row_used=row_used,
         num_leaves=state.num_leaves + 1,
     )
     gain_eff = gain_eff.at[l].set(NEG_INF).at[new_leaf].set(NEG_INF)
@@ -147,7 +210,9 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
 @functools.partial(
     jax.jit,
     static_argnames=("max_leaves", "num_bins", "max_depth", "hist_method",
-                     "exact", "axis_name", "with_categorical"))
+                     "exact", "axis_name", "with_categorical", "with_monotone",
+                     "with_interactions", "cegb_mode", "extra_trees",
+                     "use_bynode"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
               feature_mask: jax.Array, missing_bin: jax.Array, *,
@@ -155,8 +220,20 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               hist_method: str = "scatter",
               exact: bool = False,
               with_categorical: bool = False,
-              axis_name: str | None = None) -> Tuple[TreeArrays, jax.Array]:
-    """Grow one tree. Returns (tree arrays, per-row leaf index).
+              with_monotone: bool = False,
+              with_interactions: bool = False,
+              interaction_groups: jax.Array | None = None,
+              cegb_mode: str = "off",
+              cegb_coupled: jax.Array | None = None,
+              cegb_lazy_penalty: jax.Array | None = None,
+              cegb_state: GrowAux | None = None,
+              extra_trees: bool = False,
+              use_bynode: bool = False,
+              bynode_fraction: jax.Array | None = None,
+              rng_key: jax.Array | None = None,
+              axis_name: str | None = None
+              ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
+    """Grow one tree. Returns (tree arrays, per-row leaf index, aux state).
 
     Args:
       bins: [N, F] binned features (device-resident, uint8/int32).
@@ -171,6 +248,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         binds, at the cost of one histogram pass per split. The default
         batched mode performs all available splits per round (see module
         docstring for the equivalence argument).
+      interaction_groups: [G, F] bool group membership when
+        with_interactions.
+      cegb_mode: "off" | "feat" (split+coupled penalties) | "lazy" (adds the
+        per-row on-demand costs); cegb_state carries the cross-iteration
+        used-feature tracking.
+      rng_key: PRNG key, consumed when extra_trees or use_bynode.
       axis_name: when set, rows are sharded over this mesh axis (shard_map
         context): root sums and histograms are psum'd over it — the SPMD
         analog of the reference data-parallel learner's root allreduce
@@ -181,22 +264,34 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     n, f = bins.shape
     L = max_leaves
     cat_words = max(1, -(-num_bins // 32))
+    cegb_lazy = cegb_mode == "lazy"
+    cegb_on = cegb_mode != "off"
 
     stats = jnp.stack([grad * sample_mask, hess * sample_mask, sample_mask],
                       axis=1).astype(jnp.float32)
     root = jnp.sum(stats, axis=0)
     if axis_name is not None:
         root = jax.lax.psum(root, axis_name)
+    from ..ops.split import calculate_leaf_output
     root_out = calculate_leaf_output(root[0], root[1], params, root[2],
                                      jnp.float32(0.0))
+
+    if rng_key is None:
+        rng_key = jax.random.PRNGKey(0)
 
     def init_state() -> GrowState:
         zero_best = find_best_splits(  # shape-consistent placeholder (all -inf)
             jnp.zeros((L, f, num_bins, 3), jnp.float32),
             jnp.zeros((L,)), jnp.zeros((L,)), jnp.zeros((L,)), jnp.zeros((L,)),
             jnp.zeros((L,), jnp.int32), meta, params,
-            feature_mask, max_depth, with_categorical=False,
-            cat_words=cat_words)
+            feature_mask if feature_mask.ndim == 1 else feature_mask[:1, :],
+            max_depth, with_categorical=False, cat_words=cat_words)
+        if cegb_state is not None:
+            used_split = cegb_state.used_split
+            row_used = cegb_state.row_used
+        else:
+            used_split = jnp.zeros((f,), bool)
+            row_used = jnp.zeros((n, f) if cegb_lazy else (1, 1), bool)
         return GrowState(
             leaf_id=jnp.zeros((n,), jnp.int32),
             hist=jnp.zeros((L, f, num_bins, 3), jnp.float32),
@@ -207,6 +302,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             leaf_cnt=jnp.zeros((L,)).at[0].set(root[2]),
             leaf_output=jnp.zeros((L,)).at[0].set(root_out),
             leaf_depth=jnp.zeros((L,), jnp.int32),
+            leaf_min=jnp.full((L,), -F32_MAX, jnp.float32),
+            leaf_max=jnp.full((L,), F32_MAX, jnp.float32),
+            used_path=jnp.zeros((L, f) if with_interactions else (1, 1), bool),
+            used_split=used_split,
+            row_used=row_used,
             best=zero_best,
             tree=empty_tree(L, cat_words),
             num_leaves=jnp.int32(1),
@@ -219,6 +319,54 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def outer_cond(state: GrowState) -> jax.Array:
         pending = active_mask(state) & ~state.hist_valid & ~state.leaf_dead
         return (state.num_leaves < L) & jnp.any(pending) & (state.rounds < L)
+
+    def leaf_feature_mask(state: GrowState, round_key) -> jax.Array:
+        """Per-(leaf, feature) validity: global column sampling x interaction
+        constraints x per-node sampling."""
+        fmask = feature_mask
+        if fmask.ndim == 1:
+            fmask = jnp.broadcast_to(fmask[None, :], (L, f))
+        out = fmask.astype(bool)
+        if with_interactions:
+            # allowed[l] = union of groups containing every used feature of l
+            # (col_sampler.hpp interaction filtering): two boolean matmuls
+            grp = interaction_groups.astype(jnp.float32)        # [G, F]
+            used = state.used_path.astype(jnp.float32)          # [L, F]
+            viol = used @ (1.0 - grp).T                          # [L, G] >0 bad
+            ok = (viol < 0.5).astype(jnp.float32)
+            allowed = (ok @ grp) > 0.5                           # [L, F]
+            out = out & allowed
+        if use_bynode:
+            # per-leaf random subset of ceil(frac * F) features per round
+            # (col_sampler.hpp GetByNode resamples per node)
+            u = jax.random.uniform(jax.random.fold_in(round_key, 1), (L, f))
+            k = jnp.maximum(
+                jnp.ceil(bynode_fraction * f).astype(jnp.int32), 1)
+            rank = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
+            out = out & (rank < k)
+        return out
+
+    def cegb_adjust(state: GrowState) -> jax.Array | None:
+        """CEGB delta per (leaf, feature) subtracted from stored gains
+        (cost_effective_gradient_boosting.hpp:66-84 DetlaGain)."""
+        if not cegb_on:
+            return None
+        delta = (params.cegb_tradeoff * params.cegb_penalty_split
+                 * state.leaf_cnt)[:, None]                      # [L, 1]
+        delta = jnp.broadcast_to(delta, (L, f))
+        if cegb_coupled is not None:
+            delta = delta + jnp.where(state.used_split[None, :], 0.0,
+                                      params.cegb_tradeoff
+                                      * cegb_coupled[None, :])
+        if cegb_lazy and cegb_lazy_penalty is not None:
+            onehot = jax.nn.one_hot(state.leaf_id, L, dtype=jnp.float32)
+            unused = 1.0 - state.row_used.astype(jnp.float32)    # [N, F]
+            cnt_unused = onehot.T @ unused                       # [L, F]
+            if axis_name is not None:
+                cnt_unused = jax.lax.psum(cnt_unused, axis_name)
+            delta = delta + (params.cegb_tradeoff
+                             * cegb_lazy_penalty[None, :] * cnt_unused)
+        return delta
 
     def outer_body(state: GrowState) -> GrowState:
         active = active_mask(state)
@@ -238,23 +386,41 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         hist = jnp.where(pending[:, None, None, None], new_hist, state.hist)
         hist_valid = state.hist_valid | pending
 
-        best = find_best_splits(hist, state.leaf_sum_g, state.leaf_sum_h,
-                                state.leaf_cnt, state.leaf_output,
-                                state.leaf_depth, meta, params,
-                                feature_mask, max_depth,
-                                with_categorical=with_categorical,
-                                cat_words=cat_words)
+        round_key = jax.random.fold_in(rng_key, state.rounds)
+        fmask = leaf_feature_mask(state, round_key)
+        rand_bin = None
+        if extra_trees:
+            # one random threshold per (leaf, feature) per search
+            # (feature_histogram.hpp USE_RAND rand.NextInt)
+            nbm = jnp.maximum(meta.num_bins - 2, 1)
+            u = jax.random.uniform(jax.random.fold_in(round_key, 2), (L, f))
+            rand_bin = (u * nbm[None, :]).astype(jnp.int32)
+
+        best = find_best_splits(
+            hist, state.leaf_sum_g, state.leaf_sum_h,
+            state.leaf_cnt, state.leaf_output,
+            state.leaf_depth, meta, params,
+            fmask, max_depth,
+            with_categorical=with_categorical, cat_words=cat_words,
+            leaf_min=state.leaf_min if with_monotone else None,
+            leaf_max=state.leaf_max if with_monotone else None,
+            gain_adjust=cegb_adjust(state),
+            rand_bin=rand_bin)
         state = state._replace(hist=hist, hist_valid=hist_valid,
                                leaf_dead=leaf_dead, best=best,
                                rounds=state.rounds + 1)
 
         gain_eff = jnp.where(active & hist_valid & ~leaf_dead, best.gain, NEG_INF)
 
+        apply_kw = dict(with_monotone=with_monotone,
+                        with_interactions=with_interactions,
+                        cegb_lazy=cegb_lazy)
+
         if exact:
             # strict best-first: one split per round, then recompute children
             def do_split(carry):
                 st, ge = carry
-                return _apply_split(st, bins, missing_bin, ge)
+                return _apply_split(st, bins, missing_bin, ge, meta, **apply_kw)
 
             state, _ = jax.lax.cond(
                 (state.num_leaves < L) & (jnp.max(gain_eff) > 0.0),
@@ -271,10 +437,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         def inner_body(carry):
             st, ge = carry
-            return _apply_split(st, bins, missing_bin, ge)
+            return _apply_split(st, bins, missing_bin, ge, meta, **apply_kw)
 
         state, _ = jax.lax.while_loop(inner_cond, inner_body, (state, gain_eff))
         return state
 
     state = jax.lax.while_loop(outer_cond, outer_body, init_state())
-    return state.tree, state.leaf_id
+    return state.tree, state.leaf_id, GrowAux(state.used_split, state.row_used)

@@ -183,12 +183,20 @@ class HostTree:
         self.missing_type = (missing_types[:n].astype(np.int8)
                              if missing_types is not None
                              else np.zeros(n, dtype=np.int8))
+        # linear-leaf model (reference: tree.h:194-204 leaf_coeff_/leaf_const_)
+        self.is_linear = False
+        self.leaf_const: np.ndarray | None = None
+        self.leaf_coeff: list = []
+        self.leaf_features_raw: list = []
 
     def scaled(self, factor: float) -> "HostTree":
         """Copy with outputs scaled (reference: Tree::Shrinkage, tree.h:187;
-        used by DART normalization)."""
+        used by DART normalization). Linear coefficients scale too."""
         out = copy.copy(self)
         out.leaf_value = self.leaf_value * factor
         out.internal_value = self.internal_value * factor
         out.shrinkage = self.shrinkage * factor
+        if self.is_linear and self.leaf_const is not None:
+            out.leaf_const = self.leaf_const * factor
+            out.leaf_coeff = [[c * factor for c in cs] for cs in self.leaf_coeff]
         return out

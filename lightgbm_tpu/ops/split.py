@@ -75,6 +75,9 @@ class SplitParams(NamedTuple):
     max_cat_threshold: jax.Array
     min_data_per_group: jax.Array
     max_cat_to_onehot: jax.Array
+    monotone_penalty: jax.Array
+    cegb_tradeoff: jax.Array
+    cegb_penalty_split: jax.Array
 
     @classmethod
     def from_config(cls, config) -> "SplitParams":
@@ -92,6 +95,9 @@ class SplitParams(NamedTuple):
             max_cat_threshold=jnp.int32(config.max_cat_threshold),
             min_data_per_group=f32(config.min_data_per_group),
             max_cat_to_onehot=jnp.int32(config.max_cat_to_onehot),
+            monotone_penalty=f32(config.monotone_penalty),
+            cegb_tradeoff=f32(config.cegb_tradeoff),
+            cegb_penalty_split=f32(config.cegb_penalty_split),
         )
 
 
@@ -194,7 +200,8 @@ def find_best_cat_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
                          leaf_output, leaf_depth, meta: FeatureMeta,
                          p: SplitParams, feature_mask: jax.Array,
                          max_depth: int = -1,
-                         cat_words: int = CAT_BITSET_WORDS):
+                         cat_words: int = CAT_BITSET_WORDS,
+                         gain_adjust=None):
     """Best categorical split per leaf over all categorical features.
 
     Vectorized re-design of the reference's categorical threshold search
@@ -320,10 +327,21 @@ def find_best_cat_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
 
     oh_val = oh_ok & base_ok & use_onehot[:, :, None]
     so_val = base_ok & ~use_onehot[:, :, None]
+
+    # adjusted "key" gains: stored gain x feature contri - CEGB delta
+    # (matches the numerical path; monotone never applies to categoricals)
+    contri = meta.penalty[None, :, None]
+
+    def keyed(gain, valid):
+        key = (gain - min_gain_shift) * contri
+        if gain_adjust is not None:
+            key = key - gain_adjust[:, :, None]
+        return jnp.where(valid, key, K_MIN_SCORE)
+
     gains = jnp.stack([
-        jnp.where(oh_val & (oh_gain > min_gain_shift), oh_gain, K_MIN_SCORE),
-        jnp.where(so_val & fw_ok & (fw_gain > min_gain_shift), fw_gain, K_MIN_SCORE),
-        jnp.where(so_val & bw_ok & (bw_gain > min_gain_shift), bw_gain, K_MIN_SCORE),
+        keyed(oh_gain, oh_val & (oh_gain > min_gain_shift)),
+        keyed(fw_gain, so_val & fw_ok & (fw_gain > min_gain_shift)),
+        keyed(bw_gain, so_val & bw_ok & (bw_gain > min_gain_shift)),
     ], axis=2)                                                   # [L, F, 3, B]
 
     # lexicographic argmax: features in index order, then evaluation order
@@ -381,26 +399,46 @@ def find_best_cat_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
             f"bitset width {nwords} exceeds cat_words={cat_words}")
 
     l2_out = jnp.where(use_onehot[0, bf], p.lambda_l2, l2_sorted)
-    shift = min_gain_shift[:, 0, 0]
-    stored_gain = jnp.where(jnp.isfinite(best_gain), best_gain - shift, K_MIN_SCORE)
-    return (stored_gain.astype(jnp.float32), bf, left_g, left_h, left_c,
+    return (best_gain.astype(jnp.float32), bf, left_g, left_h, left_c,
             words, l2_out)
+
+
+def monotone_split_penalty(leaf_depth, p: SplitParams):
+    """Depth-decaying gain multiplier for splits on monotone features
+    (reference: monotone_constraints.hpp:355-364)."""
+    d = leaf_depth.astype(jnp.float32)
+    pen = p.monotone_penalty
+    small = 1.0 - pen / jnp.exp2(d) + K_EPSILON
+    large = 1.0 - jnp.exp2(pen - 1.0 - d) + K_EPSILON
+    out = jnp.where(pen <= 1.0, small, large)
+    out = jnp.where(pen >= d + 1.0, K_EPSILON, out)
+    return jnp.where(pen > 0.0, out, 1.0)
 
 
 def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
                      leaf_output, leaf_depth, meta: FeatureMeta, p: SplitParams,
                      feature_mask: jax.Array, max_depth: int = -1,
                      with_categorical: bool = False,
-                     cat_words: int = CAT_BITSET_WORDS) -> SplitInfo:
+                     cat_words: int = CAT_BITSET_WORDS,
+                     leaf_min=None, leaf_max=None,
+                     gain_adjust=None, rand_bin=None) -> SplitInfo:
     """Best split per leaf over all numerical features.
 
     Args:
       hist: [L, F, B, 3] (grad, hess, count).
       leaf_sum_g/h/cnt/output/depth: [L] current leaf aggregates.
       feature_mask: [F] or [L, F] float/bool validity (col sampling,
-        interaction constraints).
+        per-node sampling, interaction constraints).
       max_depth: static; leaves at max_depth get gain -inf (reference:
         serial_tree_learner.cpp BeforeFindBestSplit depth guard).
+      leaf_min/leaf_max: [L] monotone output bounds; when set (static),
+        candidate outputs are clipped and monotone-violating candidates
+        rejected (reference: feature_histogram.hpp:766-824 GetSplitGains
+        with USE_MC + BasicConstraint clip).
+      gain_adjust: [L, F] additive penalty subtracted from the stored gain
+        (the CEGB delta, cost_effective_gradient_boosting.hpp:66-84).
+      rand_bin: [L, F] int32 forced random threshold for extra_trees
+      (feature_histogram.hpp USE_RAND): only that bin is a candidate.
     Returns SplitInfo with arrays of shape [L].
     """
     L, F, B, _ = hist.shape
@@ -419,27 +457,41 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
     s = _directional_sums(hist_excl, leaf_sum_g, leaf_sum_h, leaf_cnt)
 
     parent_out = leaf_output[:, None, None]
-    num_data = leaf_cnt[:, None, None]
 
-    def side_gain(g, h, c):
-        return leaf_gain(g, h, p, c, parent_out)
+    use_mc = leaf_min is not None
 
-    gain_fwd = side_gain(s["fwd_left_g"], s["fwd_left_h"], s["fwd_left_c"]) + \
-        side_gain(s["fwd_right_g"], s["fwd_right_h"], s["fwd_right_c"])
-    gain_rev = side_gain(s["rev_left_g"], s["rev_left_h"], s["rev_left_c"]) + \
-        side_gain(s["rev_right_g"], s["rev_right_h"], s["rev_right_c"])
+    def clip_out(out):
+        if not use_mc:
+            return out
+        return jnp.clip(out, leaf_min[:, None, None], leaf_max[:, None, None])
+
+    def split_gain_dir(prefix):
+        lg, lh, lc = s[f"{prefix}_left_g"], s[f"{prefix}_left_h"], s[f"{prefix}_left_c"]
+        rg, rh, rc = s[f"{prefix}_right_g"], s[f"{prefix}_right_h"], s[f"{prefix}_right_c"]
+        lo = clip_out(calculate_leaf_output(lg, lh, p, lc, parent_out))
+        ro = clip_out(calculate_leaf_output(rg, rh, p, rc, parent_out))
+        gain = (leaf_gain_given_output(lg, lh, lo, p)
+                + leaf_gain_given_output(rg, rh, ro, p))
+        if use_mc:
+            mono = meta.monotone[None, :, None].astype(jnp.int32)
+            viol = (((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro)))
+            gain = jnp.where(viol, 0.0, gain)   # GetSplitGains returns 0
+        return gain
+
+    gain_fwd = split_gain_dir("fwd")
+    gain_rev = split_gain_dir("rev")
 
     min_gain_shift = (leaf_gain(leaf_sum_g, leaf_sum_h, p, leaf_cnt, leaf_output)
                       + p.min_gain_to_split)[:, None, None]
 
-    def constraint_mask(lg, lh, lc, rg, rh, rc):
+    def constraint_mask(prefix):
+        lh, lc = s[f"{prefix}_left_h"], s[f"{prefix}_left_c"]
+        rh, rc = s[f"{prefix}_right_h"], s[f"{prefix}_right_c"]
         return ((lc >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
                 & (lh >= p.min_sum_hessian_in_leaf) & (rh >= p.min_sum_hessian_in_leaf))
 
-    valid_fwd = constraint_mask(s["fwd_left_g"], s["fwd_left_h"], s["fwd_left_c"],
-                                s["fwd_right_g"], s["fwd_right_h"], s["fwd_right_c"])
-    valid_rev = constraint_mask(s["rev_left_g"], s["rev_left_h"], s["rev_left_c"],
-                                s["rev_right_g"], s["rev_right_h"], s["rev_right_c"])
+    valid_fwd = constraint_mask("fwd")
+    valid_rev = constraint_mask("rev")
 
     # threshold-range masks (see module docstring for the scan ranges)
     thr_ok_common = bins <= nb - 2
@@ -449,6 +501,10 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
     zero_thr_skip = (mode_a & is_zero)[None, :, None] & (bins == meta.default_bin[None, :, None])
     fwd_ok = fwd_ok & ~zero_thr_skip
     rev_ok = rev_ok & ~zero_thr_skip
+    if rand_bin is not None:   # extra_trees: only the random threshold
+        rb = rand_bin[:, :, None]
+        fwd_ok = fwd_ok & (bins == rb)
+        rev_ok = rev_ok & (bins == rb)
 
     fmask = feature_mask
     if fmask.ndim == 1:
@@ -461,8 +517,24 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
     valid_fwd = valid_fwd & fwd_ok & base_ok & (gain_fwd > min_gain_shift) & ~jnp.isnan(gain_fwd)
     valid_rev = valid_rev & rev_ok & base_ok & (gain_rev > min_gain_shift) & ~jnp.isnan(gain_rev)
 
-    gain_fwd = jnp.where(valid_fwd, gain_fwd, K_MIN_SCORE)
-    gain_rev = jnp.where(valid_rev, gain_rev, K_MIN_SCORE)
+    # ---- adjusted "key" gains: the stored gain after per-feature contri
+    # multiplier (feature_histogram.hpp:94 output->gain *= meta->penalty),
+    # minus the CEGB delta (serial_tree_learner.cpp:740-744), times the
+    # monotone penalty (serial_tree_learner.cpp:745-749). Cross-feature and
+    # cross-leaf comparisons all happen on these adjusted gains.
+    contri = meta.penalty[None, :, None]
+    mono_pen = monotone_split_penalty(leaf_depth, p)[:, None, None]
+    is_mono = (meta.monotone != 0)[None, :, None]
+
+    def keyed(gain, valid):
+        key = (gain - min_gain_shift) * contri
+        if gain_adjust is not None:
+            key = key - gain_adjust[:, :, None]
+        key = jnp.where(is_mono, key * mono_pen, key)
+        return jnp.where(valid, key, K_MIN_SCORE)
+
+    gain_fwd = keyed(gain_fwd, valid_fwd)
+    gain_rev = keyed(gain_rev, valid_rev)
 
     # ---- lexicographic argmax reproducing the reference's scan tie order:
     # reverse scan runs first and keeps the first (=highest-threshold) maximum;
@@ -502,17 +574,17 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
 
     left_out = calculate_leaf_output(left_g, left_h, p, left_c, leaf_output)
     right_out = calculate_leaf_output(right_g, right_h, p, right_c, leaf_output)
+    if use_mc:
+        left_out = jnp.clip(left_out, leaf_min, leaf_max)
+        right_out = jnp.clip(right_out, leaf_min, leaf_max)
 
     # default_left: reverse scan => True; forced False for NaN single-scan mode
     # (feature_histogram.hpp:199-210)
     nan_single = (is_nan & ~mode_a)[bf]
     default_left = (bdir == 0) & ~nan_single
 
-    shift = min_gain_shift[:, 0, 0]
-    stored_gain = jnp.where(jnp.isfinite(best_gain), best_gain - shift, K_MIN_SCORE)
-
     num_info = SplitInfo(
-        gain=stored_gain.astype(jnp.float32),
+        gain=best_gain.astype(jnp.float32),
         feature=bf,
         threshold=bt,
         default_left=default_left,
@@ -527,12 +599,16 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
 
     (cgain, cfeat, clg, clh, clc, cbits, cl2) = find_best_cat_splits(
         hist, leaf_sum_g, leaf_sum_h, leaf_cnt, leaf_output, leaf_depth,
-        meta, p, feature_mask, max_depth, cat_words)
+        meta, p, feature_mask, max_depth, cat_words,
+        gain_adjust=gain_adjust)
     crg = leaf_sum_g - clg
     crh = leaf_sum_h - clh
     crc = leaf_cnt - clc
     clo = calculate_leaf_output(clg, clh, p, clc, leaf_output, cl2)
     cro = calculate_leaf_output(crg, crh, p, crc, leaf_output, cl2)
+    if use_mc:
+        clo = jnp.clip(clo, leaf_min, leaf_max)
+        cro = jnp.clip(cro, leaf_min, leaf_max)
     # per-leaf choice between numerical and categorical bests; ties resolve
     # to the lower feature index (the reference's in-order feature loop with
     # strict operator>, serial_tree_learner.cpp:374-448)

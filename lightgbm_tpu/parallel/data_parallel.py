@@ -75,12 +75,13 @@ def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
         max_depth=max_depth, hist_method=hist_method, exact=exact,
         with_categorical=with_categorical, axis_name=axis)
 
+    from ..models.grower import GrowAux
     shard = jax.shard_map(
         grow, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis),
                   P(), P(), P(), P()),
-        out_specs=(P(), P(axis)),
+        out_specs=(P(), P(axis), GrowAux(P(), P())),
         check_vma=False)
-    tree, leaf_id = shard(bins, grad, hess, sample_mask, meta, params,
-                          feature_mask, missing_bin)
+    tree, leaf_id, _aux = shard(bins, grad, hess, sample_mask, meta, params,
+                                feature_mask, missing_bin)
     return tree, leaf_id[:n]

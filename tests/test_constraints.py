@@ -1,0 +1,189 @@
+"""Tree-learner constraint features: monotone, interaction, feature_contri,
+extra_trees, CEGB, per-node feature sampling.
+
+Mirrors the reference coverage (reference: tests/python_package_test/
+test_engine.py:1256 monotone, interaction-constraint and cegb tests;
+semantics from src/treelearner/monotone_constraints.hpp,
+col_sampler.hpp, cost_effective_gradient_boosting.hpp)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def reg_data():
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = rng.uniform(-2, 2, size=(n, 4))
+    y = (2 * X[:, 0] - 1.5 * X[:, 1] + 0.5 * np.sin(3 * X[:, 2])
+         + 0.2 * rng.normal(size=n))
+    return X, y
+
+
+BASE = {"objective": "regression", "num_leaves": 31, "min_data_in_leaf": 5,
+        "verbosity": -1}
+
+
+def _sweep(booster, feat, lo=-2.0, hi=2.0, npts=60):
+    base = np.zeros((npts, 4))
+    base[:, feat] = np.linspace(lo, hi, npts)
+    return booster.predict(base)
+
+
+def test_monotone_constraints_enforced(reg_data):
+    X, y = reg_data
+    params = dict(BASE, monotone_constraints=[1, -1, 0, 0])
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=30)
+    from sklearn.metrics import r2_score
+    assert r2_score(y, booster.predict(X)) > 0.9
+    p0 = _sweep(booster, 0)
+    assert np.all(np.diff(p0) >= -1e-10), "monotone +1 violated"
+    p1 = _sweep(booster, 1)
+    assert np.all(np.diff(p1) <= 1e-10), "monotone -1 violated"
+
+
+def test_monotone_unconstrained_differs(reg_data):
+    """Sanity: the constraint must actually bind (sin feature would wiggle)."""
+    X, y = reg_data
+    params = dict(BASE, monotone_constraints=[0, 0, 1, 0])
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=30)
+    p2 = _sweep(booster, 2)
+    assert np.all(np.diff(p2) >= -1e-10)
+    free = lgb.train(BASE, lgb.Dataset(X, label=y, params=BASE,
+                                       free_raw_data=False),
+                     num_boost_round=30)
+    p2f = _sweep(free, 2)
+    assert not np.all(np.diff(p2f) >= -1e-10), \
+        "unconstrained model should follow the non-monotone sin signal"
+
+
+def test_monotone_model_round_trip(reg_data):
+    X, y = reg_data
+    params = dict(BASE, monotone_constraints=[1, -1, 0, 0])
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=10)
+    s = booster.model_to_string()
+    assert "monotone_constraints=1 -1 0 0" in s
+    loaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(booster.predict(X), loaded.predict(X))
+
+
+def _tree_paths(model):
+    out = []
+
+    def walk(node, cur):
+        if "split_feature" in node:
+            cur = cur | {node["split_feature"]}
+            walk(node["left_child"], cur)
+            walk(node["right_child"], cur)
+        else:
+            out.append(cur)
+    for ti in model["tree_info"]:
+        walk(ti["tree_structure"], set())
+    return out
+
+
+def test_interaction_constraints(reg_data):
+    X, y = reg_data
+    params = dict(BASE, num_leaves=15, interaction_constraints=[[0, 1], [2, 3]])
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=10)
+    for path_feats in _tree_paths(booster.dump_model()):
+        assert path_feats <= {0, 1} or path_feats <= {2, 3}, path_feats
+
+
+def test_feature_contri_zero_excludes_feature(reg_data):
+    X, y = reg_data
+    params = dict(BASE, num_leaves=15, feature_contri=[0.0, 1.0, 1.0, 1.0])
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=5)
+    assert booster.feature_importance()[0] == 0
+
+
+def test_cegb_coupled_penalty_excludes_feature(reg_data):
+    X, y = reg_data
+    params = dict(BASE, num_leaves=15, cegb_tradeoff=1.0,
+                  cegb_penalty_feature_coupled=[1e9, 0.0, 0.0, 0.0])
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=5)
+    assert booster.feature_importance()[0] == 0
+
+
+def test_cegb_split_penalty_shrinks_trees(reg_data):
+    X, y = reg_data
+    free = lgb.train(BASE, lgb.Dataset(X, label=y, params=BASE,
+                                       free_raw_data=False), num_boost_round=5)
+    params = dict(BASE, cegb_tradeoff=1.0, cegb_penalty_split=10.0)
+    pen = lgb.train(params, lgb.Dataset(X, label=y, params=params,
+                                        free_raw_data=False), num_boost_round=5)
+    assert pen.feature_importance().sum() < free.feature_importance().sum()
+
+
+def test_cegb_lazy_trains(reg_data):
+    X, y = reg_data
+    params = dict(BASE, num_leaves=15, cegb_tradeoff=1.0,
+                  cegb_penalty_feature_lazy=[0.001, 0.0, 0.0, 0.0])
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=5)
+    from sklearn.metrics import r2_score
+    assert r2_score(y, booster.predict(X)) > 0.3
+
+
+def test_extra_trees(reg_data):
+    X, y = reg_data
+    params = dict(BASE, num_leaves=15, extra_trees=True)
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=20)
+    from sklearn.metrics import r2_score
+    assert r2_score(y, booster.predict(X)) > 0.8
+    # deterministic under the same extra_seed
+    ds2 = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster2 = lgb.train(params, ds2, num_boost_round=20)
+    np.testing.assert_allclose(booster.predict(X), booster2.predict(X))
+
+
+def test_feature_fraction_bynode(reg_data):
+    X, y = reg_data
+    params = dict(BASE, num_leaves=15, feature_fraction_bynode=0.5)
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=10)
+    from sklearn.metrics import r2_score
+    assert r2_score(y, booster.predict(X)) > 0.5
+
+
+def test_monotone_penalty(reg_data):
+    """monotone_penalty=2 makes monotone-feature splits at depth 0 and 1
+    worthless (factor ~kEpsilon, monotone_constraints.hpp:355-364), so the
+    monotone feature must not appear in the top two tree levels."""
+    X, y = reg_data
+    params = dict(BASE, monotone_constraints=[1, 0, 0, 0],
+                  monotone_penalty=2.0)
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=10)
+    p0 = _sweep(booster, 0)
+    assert np.all(np.diff(p0) >= -1e-10)
+
+    def shallow_feats(node, depth, out):
+        if "split_feature" in node:
+            if depth <= 1:
+                out.append(node["split_feature"])
+                shallow_feats(node["left_child"], depth + 1, out)
+                shallow_feats(node["right_child"], depth + 1, out)
+        return out
+
+    for ti in booster.dump_model()["tree_info"]:
+        feats = shallow_feats(ti["tree_structure"], 0, [])
+        assert 0 not in feats, f"monotone feature split at depth<=1: {feats}"
+    # the (unpenalized) baseline does use f0 shallow — the penalty binds
+    params_np = dict(BASE, monotone_constraints=[1, 0, 0, 0])
+    base = lgb.train(params_np, lgb.Dataset(X, label=y, params=params_np,
+                                            free_raw_data=False),
+                     num_boost_round=10)
+    base_shallow = []
+    for ti in base.dump_model()["tree_info"]:
+        base_shallow += shallow_feats(ti["tree_structure"], 0, [])
+    assert 0 in base_shallow

@@ -41,7 +41,7 @@ def test_dp_matches_single_device(mesh8, exact):
     args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
             jnp.ones((n,), jnp.float32), meta, params,
             jnp.ones((f,), jnp.float32), jnp.asarray(missing_bin))
-    tree_s, leaf_s = grow_tree(*args, max_leaves=16, num_bins=16, exact=exact)
+    tree_s, leaf_s, _aux = grow_tree(*args, max_leaves=16, num_bins=16, exact=exact)
     tree_d, leaf_d = grow_tree_dp(mesh8, *args, max_leaves=16, num_bins=16,
                                   exact=exact)
     assert int(tree_s.num_leaves) == int(tree_d.num_leaves)
@@ -65,7 +65,7 @@ def test_dp_rows_not_divisible(mesh8):
     args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
             jnp.ones((n,), jnp.float32), meta, params,
             jnp.ones((f,), jnp.float32), jnp.asarray(missing_bin))
-    tree_s, leaf_s = grow_tree(*args, max_leaves=8, num_bins=16)
+    tree_s, leaf_s, _aux = grow_tree(*args, max_leaves=8, num_bins=16)
     tree_d, leaf_d = grow_tree_dp(mesh8, *args, max_leaves=8, num_bins=16)
     assert leaf_d.shape[0] == n
     np.testing.assert_array_equal(np.asarray(tree_s.node_feature)[:int(tree_s.num_leaves) - 1],
@@ -83,6 +83,6 @@ def test_dp_bagging_mask(mesh8):
     args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
             jnp.asarray(mask), meta, params,
             jnp.ones((f,), jnp.float32), jnp.asarray(missing_bin))
-    tree_s, leaf_s = grow_tree(*args, max_leaves=8, num_bins=16)
+    tree_s, leaf_s, _aux = grow_tree(*args, max_leaves=8, num_bins=16)
     tree_d, leaf_d = grow_tree_dp(mesh8, *args, max_leaves=8, num_bins=16)
     np.testing.assert_array_equal(np.asarray(leaf_s), np.asarray(leaf_d))

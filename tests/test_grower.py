@@ -19,7 +19,8 @@ def _make_params(l1=0.0, l2=0.0, min_data=1, min_hess=1e-3, min_gain=0.0):
         min_sum_hessian_in_leaf=f32(min_hess), min_gain_to_split=f32(min_gain),
         cat_l2=f32(10.0), cat_smooth=f32(10.0),
         max_cat_threshold=jnp.int32(32), min_data_per_group=f32(100.0),
-        max_cat_to_onehot=jnp.int32(4))
+        max_cat_to_onehot=jnp.int32(4), monotone_penalty=f32(0.0),
+        cegb_tradeoff=f32(1.0), cegb_penalty_split=f32(0.0))
 
 
 def _make_meta(num_bins, missing_types=None, default_bins=None):
@@ -51,7 +52,7 @@ def _run_both(bins, grad, hess, num_bins_per_feat, num_leaves, seed_missing=None
     meta, missing_bin = _make_meta(num_bins_per_feat, mt)
     params = _make_params(l1, l2, min_data, min_hess, min_gain)
     B = int(max(num_bins_per_feat))
-    tree, leaf_id = grow_tree(
+    tree, leaf_id, _aux = grow_tree(
         jnp.asarray(bins.astype(np.uint8)), jnp.asarray(grad, dtype=jnp.float32),
         jnp.asarray(hess, dtype=jnp.float32), jnp.ones((n,), dtype=jnp.float32),
         meta, params, jnp.ones((f,), dtype=jnp.float32),
@@ -184,7 +185,7 @@ def test_predict_leaf_consistency():
     hess = np.ones(n)
     meta, missing_bin = _make_meta([16] * 4)
     params = _make_params(min_data=5)
-    tree, leaf_id = grow_tree(
+    tree, leaf_id, _aux = grow_tree(
         jnp.asarray(bins), jnp.asarray(grad, dtype=jnp.float32),
         jnp.asarray(hess, dtype=jnp.float32), jnp.ones((n,), jnp.float32),
         meta, params, jnp.ones((4,), jnp.float32), jnp.asarray(missing_bin),

@@ -1,0 +1,103 @@
+"""Linear trees: leaves hold linear models on branch features
+(reference: src/treelearner/linear_tree_learner.cpp; tested via
+tests/python_package_test/test_engine.py:2540)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+BASE = {"objective": "regression", "num_leaves": 8, "min_data_in_leaf": 20,
+        "verbosity": -1}
+
+
+@pytest.fixture(scope="module")
+def piecewise_linear():
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = rng.uniform(-2, 2, size=(n, 4))
+    y = (np.where(X[:, 0] > 0, 3 * X[:, 1] + 1, -2 * X[:, 1])
+         + 0.1 * rng.normal(size=n))
+    return X, y
+
+
+def test_linear_beats_plain_on_linear_target(piecewise_linear):
+    from sklearn.metrics import r2_score
+    X, y = piecewise_linear
+    plain = lgb.train(BASE, lgb.Dataset(X, label=y, params=BASE,
+                                        free_raw_data=False),
+                      num_boost_round=10)
+    lin_p = dict(BASE, linear_tree=True, linear_lambda=0.01)
+    lin = lgb.train(lin_p, lgb.Dataset(X, label=y, params=lin_p,
+                                       free_raw_data=False),
+                    num_boost_round=10)
+    assert r2_score(y, lin.predict(X)) > r2_score(y, plain.predict(X))
+
+
+def test_linear_model_round_trip(piecewise_linear):
+    X, y = piecewise_linear
+    lin_p = dict(BASE, linear_tree=True)
+    booster = lgb.train(lin_p, lgb.Dataset(X, label=y, params=lin_p,
+                                           free_raw_data=False),
+                        num_boost_round=8)
+    s = booster.model_to_string()
+    assert "is_linear=1" in s
+    assert "leaf_coeff=" in s
+    loaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(booster.predict(X), loaded.predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_linear_nan_fallback(piecewise_linear):
+    X, y = piecewise_linear
+    lin_p = dict(BASE, linear_tree=True)
+    booster = lgb.train(lin_p, lgb.Dataset(X, label=y, params=lin_p,
+                                           free_raw_data=False),
+                        num_boost_round=5)
+    Xn = X[:10].copy()
+    Xn[:, :] = np.nan
+    p = booster.predict(Xn)
+    assert np.isfinite(p).all()
+
+
+def test_linear_valid_eval_consistent(piecewise_linear):
+    X, y = piecewise_linear
+    lin_p = dict(BASE, linear_tree=True)
+    tr = lgb.Dataset(X, label=y, params=lin_p, free_raw_data=False)
+    vs = lgb.Dataset(X, label=y, params=lin_p, reference=tr,
+                     free_raw_data=False)
+    ev = {}
+    booster = lgb.train(lin_p, tr, 8, valid_sets=[vs], evals_result=ev)
+    true_l2 = np.mean((booster.predict(X) - y) ** 2)
+    assert abs(ev["valid_0"]["l2"][-1] - true_l2) < 1e-5
+
+
+def test_linear_tree_binary_objective(piecewise_linear):
+    X, _ = piecewise_linear
+    y = (X[:, 1] + 0.3 * np.random.RandomState(1).normal(size=len(X)) > 0)
+    lin_p = dict(BASE, objective="binary", linear_tree=True)
+    booster = lgb.train(lin_p, lgb.Dataset(X, label=y.astype(float),
+                                           params=lin_p, free_raw_data=False),
+                        num_boost_round=10)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, booster.predict(X)) > 0.95
+
+
+def test_linear_tree_rejects_l1():
+    X = np.random.RandomState(0).normal(size=(200, 3))
+    y = X[:, 0]
+    lin_p = dict(BASE, objective="regression_l1", linear_tree=True)
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        lgb.train(lin_p, lgb.Dataset(X, label=y, params=lin_p,
+                                     free_raw_data=False), num_boost_round=2)
+
+
+def test_linear_tree_rejects_dart():
+    X = np.random.RandomState(0).normal(size=(200, 3))
+    y = X[:, 0]
+    lin_p = dict(BASE, boosting="dart", linear_tree=True)
+    from lightgbm_tpu.utils.log import LightGBMError
+    with pytest.raises(LightGBMError):
+        lgb.train(lin_p, lgb.Dataset(X, label=y, params=lin_p,
+                                     free_raw_data=False), num_boost_round=2)

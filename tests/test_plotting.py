@@ -1,0 +1,63 @@
+"""Plotting API (reference: tests/python_package_test/test_plotting.py)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(500, 5))
+    y = X[:, 0] + 0.5 * X[:, 1]
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    vs = lgb.Dataset(X, label=y, params=params, reference=ds,
+                     free_raw_data=False)
+    evals = {}
+    booster = lgb.train(params, ds, 10, valid_sets=[vs], evals_result=evals)
+    return booster, evals
+
+
+def test_plot_importance(fitted):
+    booster, _ = fitted
+    ax = lgb.plot_importance(booster)
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_importance(booster, importance_type="gain",
+                              max_num_features=2)
+    assert len(ax2.patches) <= 2
+
+
+def test_plot_split_value_histogram(fitted):
+    booster, _ = fitted
+    ax = lgb.plot_split_value_histogram(booster, 0)
+    assert len(ax.patches) > 0
+    with pytest.raises(ValueError):
+        lgb.plot_split_value_histogram(booster, 4)  # likely unused feature
+
+
+def test_plot_metric(fitted):
+    _, evals = fitted
+    ax = lgb.plot_metric(evals)
+    assert len(ax.lines) >= 1
+    with pytest.raises(TypeError):
+        lgb.plot_metric(fitted[0])  # Booster not accepted (reference parity)
+
+
+def test_plot_tree_and_digraph(fitted):
+    booster, _ = fitted
+    ax = lgb.plot_tree(booster)
+    assert ax is not None
+    try:
+        graph = lgb.create_tree_digraph(booster, show_info=["internal_count"])
+        assert "node0" in graph.source
+    except ImportError:
+        pytest.skip("graphviz unavailable")
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(booster, tree_index=999)
