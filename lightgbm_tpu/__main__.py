@@ -1,0 +1,8 @@
+"""``python -m lightgbm_tpu config=train.conf`` — the CLI entry point
+(reference: src/main.cpp)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -160,3 +160,124 @@ class Booster:
         from .io.model_text import load_model
         self._boosting = load_model(model_str, self.config)
         return self
+
+    def refit(self, data, label=None, weight=None, group=None,
+              decay_rate: float = 0.9) -> "Booster":
+        """Re-fit the leaf values of the existing tree structure on new data
+        (reference: GBDT::RefitTree gbdt.cpp:285-321 +
+        SerialTreeLearner::FitByExistingTree serial_tree_learner.cpp:211-244;
+        Python surface basic.py Booster.refit). Returns a NEW Booster.
+        Linear-leaf coefficients are kept as-is; only leaf constants refit."""
+        from .io.model_text import load_model
+        from .objectives import create_objective
+        import jax.numpy as jnp
+
+        loaded = load_model(self.model_to_string(), Config.from_params(self.params))
+        if label is None and hasattr(data, "get_label"):
+            label = data.get_label()
+            weight = data.get_weight() if weight is None else weight
+            group = data.get_group() if group is None else group
+            data = data.data
+        X = data
+        label = np.asarray(label, dtype=np.float64).reshape(-1)
+        leaf = loaded.predict_leaf(X)               # [N, T]
+        n = leaf.shape[0]
+        cfg = loaded.config
+        objective = create_objective(cfg)
+        if objective is None:
+            log.fatal("Cannot refit a model without a built-in objective")
+        objective.init(label, None if weight is None else
+                       np.asarray(weight, np.float64).reshape(-1),
+                       None if group is None else
+                       np.asarray(group, np.int64).reshape(-1))
+        k = loaded.num_tree_per_iteration
+        score = np.zeros((n, k) if k > 1 else (n,), np.float64)
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        mds = cfg.max_delta_step
+        eps = 1e-15
+
+        def leaf_output(sg, sh):
+            out = -np.sign(sg) * np.maximum(np.abs(sg) - l1, 0.0) / (sh + l2)
+            if mds > 0:
+                out = np.clip(out, -mds, mds)
+            return out
+
+        Xmat = None
+        if any(t.is_linear for t in loaded.trees):
+            Xmat = np.asarray(X, np.float64)
+            if Xmat.ndim == 1:
+                Xmat = Xmat.reshape(1, -1)
+        iters = loaded.num_iteration
+        for it in range(iters):
+            g, h = objective.get_grad_hess(jnp.asarray(score, jnp.float32))
+            g = np.asarray(g, np.float64)
+            h = np.asarray(h, np.float64)
+            for c in range(k):
+                tree = loaded.trees[it * k + c]
+                lp = leaf[:, it * k + c]
+                gc = g[:, c] if k > 1 else g
+                hc = h[:, c] if k > 1 else h
+                nl = tree.num_leaves
+                sum_g = np.bincount(lp, weights=gc, minlength=nl)[:nl]
+                sum_h = np.bincount(lp, weights=hc, minlength=nl)[:nl] + eps
+                new_out = leaf_output(sum_g, sum_h) * tree.shrinkage
+                tree.leaf_value = (decay_rate * tree.leaf_value
+                                   + (1.0 - decay_rate) * new_out)
+                if tree.is_linear:
+                    # re-solve the per-leaf ridge system and decay-blend
+                    # const/coeffs (linear_tree_learner.cpp:320-380
+                    # CalculateLinear(is_refit=true))
+                    self._refit_linear_leaves(tree, lp, gc, hc, Xmat,
+                                              cfg.linear_lambda, decay_rate,
+                                              new_out)
+                delta = tree.predict(Xmat) if tree.is_linear else tree.leaf_value[lp]
+                if k > 1:
+                    score[:, c] += delta
+                else:
+                    score += delta
+        new_booster = Booster.__new__(Booster)
+        new_booster.params = dict(self.params)
+        new_booster.config = loaded.config
+        new_booster.best_iteration = -1
+        new_booster.best_score = {}
+        new_booster._train_set = None
+        new_booster._boosting = loaded
+        return new_booster
+
+    @staticmethod
+    def _refit_linear_leaves(tree, lp, g, h, Xmat, linear_lambda, decay_rate,
+                             new_out) -> None:
+        """Decay-blend linear leaf const/coeffs toward a fresh per-leaf ridge
+        fit on the refit data (linear_tree_learner.cpp is_refit path; leaves
+        with too few usable rows fall back to the blended plain output with
+        zeroed coefficients, :323-329)."""
+        shrink = tree.shrinkage
+        for li in range(tree.num_leaves):
+            feats = tree.leaf_features[li] if li < len(tree.leaf_features) else []
+            old_coeffs = (tree.leaf_coeff[li]
+                          if li < len(tree.leaf_coeff) else [])
+            rows = lp == li
+            Xl = (Xmat[rows][:, feats] if feats
+                  else np.zeros((int(rows.sum()), 0)))
+            ok = ~(np.isnan(Xl).any(axis=1) | np.isinf(Xl).any(axis=1)) \
+                if feats else np.ones(int(rows.sum()), bool)
+            if ok.sum() < len(feats) + 1:
+                tree.leaf_const[li] = (decay_rate * tree.leaf_const[li]
+                                       + (1.0 - decay_rate) * new_out[li])
+                tree.leaf_coeff[li] = [0.0] * len(feats)
+                continue
+            X1 = np.concatenate([Xl[ok], np.ones((int(ok.sum()), 1))], axis=1)
+            hl = h[rows][ok]
+            gl = g[rows][ok]
+            A = X1.T @ (X1 * hl[:, None])
+            A[np.arange(len(feats)), np.arange(len(feats))] += linear_lambda
+            try:
+                sol = -np.linalg.solve(A, X1.T @ gl)
+            except np.linalg.LinAlgError:
+                sol = -(np.linalg.pinv(A) @ (X1.T @ gl))
+            tree.leaf_coeff[li] = [
+                decay_rate * (old_coeffs[i] if i < len(old_coeffs) else 0.0)
+                + (1.0 - decay_rate) * float(sol[i]) * shrink
+                for i in range(len(feats))]
+            tree.leaf_const[li] = (decay_rate * tree.leaf_const[li]
+                                   + (1.0 - decay_rate) * float(sol[-1]) * shrink)

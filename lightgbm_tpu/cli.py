@@ -1,0 +1,255 @@
+"""Command-line application.
+
+Mirrors the reference CLI (reference: src/main.cpp:11-42,
+src/application/application.cpp:31-271): ``python -m lightgbm_tpu
+config=train.conf [key=value ...]`` with tasks train / predict /
+convert_model / refit / save_binary. Data files are parsed by the native
+C++ loader (native/text_parser.cpp); side files ``<data>.weight`` /
+``<data>.query`` / ``<data>.init`` supply metadata the way the reference's
+Metadata loader does (reference: src/io/metadata.cpp)."""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .basic import Dataset
+from .booster import Booster
+from .config import Config, parse_config_file
+from .engine import train as engine_train
+from .native import parse_text_file
+from .utils import log
+
+
+def _parse_argv(argv: List[str]) -> Dict[str, str]:
+    """key=value args + config file merge (reference: application.cpp:31-85 —
+    command-line pairs override the config file)."""
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            log.fatal(f"Unknown argument: {arg} (expected key=value)")
+        key, value = arg.split("=", 1)
+        params[key.strip()] = value.strip()
+    if "config" in params:
+        file_params = parse_config_file(params.pop("config"))
+        for key, value in file_params.items():
+            params.setdefault(key, value)
+    return params
+
+
+def _column_index(spec: str, header_names: Optional[List[str]]) -> Optional[int]:
+    """Column spec: int index or 'name:<col>' (reference: config.h label_column
+    docs)."""
+    if spec == "":
+        return None
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if header_names is None or name not in header_names:
+            log.fatal(f"Column name {name} requires header=true and a matching "
+                      f"header line")
+        return header_names.index(name)
+    return int(spec)
+
+
+def _read_header(path: str, config: Config) -> Optional[List[str]]:
+    if not config.header:
+        return None
+    with open(path) as fh:
+        first = fh.readline().rstrip("\n")
+    delim = "," if "," in first else "\t"
+    return first.split(delim)
+
+
+def _side_file(path: str, suffix: str) -> Optional[np.ndarray]:
+    """Optional metadata side file (reference: metadata.cpp loads
+    <data>.weight/.query/.init when present)."""
+    side = path + suffix
+    if os.path.exists(side):
+        return np.loadtxt(side, ndmin=1)
+    return None
+
+
+def load_data_file(path: str, config: Config
+                   ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
+                              Optional[np.ndarray], Optional[np.ndarray]]:
+    """Load one data file -> (X, y, weight, group, init_score)."""
+    if path.endswith(".bin"):
+        return _load_binary(path)
+    header_names = _read_header(path, config)
+    mat, _fmt = parse_text_file(path, has_header=config.header,
+                                num_threads=config.num_threads)
+    label_idx = _column_index(config.label_column, header_names)
+    if label_idx is None:
+        label_idx = 0
+    ignore = set()
+    if config.ignore_column:
+        for part in str(config.ignore_column).split(","):
+            idx = _column_index(part, header_names)
+            if idx is not None:
+                ignore.add(idx)
+    weight_idx = _column_index(config.weight_column, header_names)
+    group_idx = _column_index(config.group_column, header_names)
+
+    y = mat[:, label_idx]
+    weight = mat[:, weight_idx] if weight_idx is not None else None
+    group_col = mat[:, group_idx] if group_idx is not None else None
+    drop = {label_idx} | ignore
+    if weight_idx is not None:
+        drop.add(weight_idx)
+    if group_idx is not None:
+        drop.add(group_idx)
+    keep = [j for j in range(mat.shape[1]) if j not in drop]
+    X = mat[:, keep]
+
+    if weight is None:
+        weight = _side_file(path, ".weight")
+    group = _side_file(path, ".query")
+    if group is None and group_col is not None:
+        # per-row query ids -> query boundaries (metadata.cpp query column)
+        _, counts = np.unique(group_col, return_counts=True)
+        group = counts
+    init_score = _side_file(path, ".init")
+    return X, y, weight, group, init_score
+
+
+def _save_binary(path: str, X, y, weight, group, init_score) -> None:
+    """Dataset binary serialization (reference: dataset_loader.cpp:316
+    LoadFromBinFile / save_binary — here a versioned npz container)."""
+    with open(path, "wb") as fh:   # file object: np.savez won't append .npz
+        np.savez_compressed(fh, version=1, X=X, y=y,
+                            weight=weight if weight is not None else np.zeros(0),
+                            group=group if group is not None else np.zeros(0),
+                            init_score=(init_score if init_score is not None
+                                        else np.zeros(0)))
+
+
+def _load_binary(path: str):
+    z = np.load(path, allow_pickle=False)
+    opt = lambda a: None if a.size == 0 else a
+    return (z["X"], z["y"], opt(z["weight"]), opt(z["group"]),
+            opt(z["init_score"]))
+
+
+def _make_dataset(path: str, config: Config, params: Dict[str, str],
+                  reference: Optional[Dataset] = None) -> Dataset:
+    X, y, weight, group, init_score = load_data_file(path, config)
+    return Dataset(X, label=y, weight=weight, group=group,
+                   init_score=init_score, reference=reference, params=params,
+                   free_raw_data=False)
+
+
+def run_train(config: Config, params: Dict[str, str]) -> None:
+    """task=train (reference: application.cpp InitTrain/Train)."""
+    if not config.data:
+        log.fatal("No training data: set data=<file>")
+    train_set = _make_dataset(config.data, config, params)
+    valid_sets, valid_names = [], []
+    for vf in config.valid:
+        valid_sets.append(_make_dataset(vf, config, params, reference=train_set))
+        valid_names.append(os.path.basename(vf))
+
+    callbacks = []
+    if config.snapshot_freq > 0:
+        # model.txt.snapshot_iter_N files (gbdt.cpp:277-281)
+        out = config.output_model
+
+        def snapshot_cb(env):
+            it = env.iteration + 1
+            if it % config.snapshot_freq == 0:
+                env.model.save_model(f"{out}.snapshot_iter_{it}")
+        snapshot_cb.order = 100
+        callbacks.append(snapshot_cb)
+
+    booster = engine_train(
+        dict(params), train_set, num_boost_round=config.num_iterations,
+        valid_sets=valid_sets, valid_names=valid_names,
+        init_model=config.input_model or None,
+        early_stopping_rounds=config.early_stopping_round or None,
+        verbose_eval=config.metric_freq if (valid_sets or
+                                            config.is_provide_training_metric)
+        else False,
+        callbacks=callbacks)
+    booster.save_model(config.output_model)
+    log.info(f"Finished training, model saved to {config.output_model}")
+
+
+def run_predict(config: Config, params: Dict[str, str]) -> None:
+    """task=predict (reference: application.cpp Predict + predictor.hpp)."""
+    if not config.input_model:
+        log.fatal("No model file: set input_model=<file>")
+    if not config.data:
+        log.fatal("No prediction data: set data=<file>")
+    booster = Booster(model_file=config.input_model)
+    X, _y, _w, _g, _i = load_data_file(config.data, config)
+    result = booster.predict(
+        X, raw_score=config.predict_raw_score,
+        pred_leaf=config.predict_leaf_index,
+        pred_contrib=config.predict_contrib,
+        num_iteration=config.num_iteration_predict,
+        start_iteration=config.start_iteration_predict)
+    result = np.atleast_2d(np.asarray(result))
+    if result.shape[0] == 1 and X.shape[0] != 1:
+        result = result.T
+    np.savetxt(config.output_result, result, fmt="%.10g", delimiter="\t")
+    log.info(f"Finished prediction, results saved to {config.output_result}")
+
+
+def run_convert_model(config: Config, params: Dict[str, str]) -> None:
+    """task=convert_model: if-else C++ codegen
+    (reference: gbdt_model_text.cpp ModelToIfElse)."""
+    if not config.input_model:
+        log.fatal("No model file: set input_model=<file>")
+    booster = Booster(model_file=config.input_model)
+    from .io.codegen import model_to_if_else
+    code = model_to_if_else(booster._boosting)
+    with open(config.convert_model, "w") as fh:
+        fh.write(code)
+    log.info(f"Converted model saved to {config.convert_model}")
+
+
+def run_refit(config: Config, params: Dict[str, str]) -> None:
+    """task=refit: re-fit leaf values of an existing model on new data
+    (reference: application.cpp:221 ConvertModel task=refit ->
+    GBDT::RefitTree, gbdt.cpp:285-321)."""
+    if not config.input_model:
+        log.fatal("No model file: set input_model=<file>")
+    if not config.data:
+        log.fatal("No refit data: set data=<file>")
+    booster = Booster(model_file=config.input_model)
+    X, y, weight, group, _i = load_data_file(config.data, config)
+    refitted = booster.refit(X, y, weight=weight, group=group,
+                             decay_rate=config.refit_decay_rate)
+    refitted.save_model(config.output_model)
+    log.info(f"Finished refit, model saved to {config.output_model}")
+
+
+def run_save_binary(config: Config, params: Dict[str, str]) -> None:
+    """task=save_binary (reference: application.cpp:260-270)."""
+    if not config.data:
+        log.fatal("No data: set data=<file>")
+    X, y, weight, group, init_score = load_data_file(config.data, config)
+    out = config.data + ".bin"
+    _save_binary(out, X, y, weight, group, init_score)
+    log.info(f"Dataset saved to {out}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    params = _parse_argv(argv)
+    config = Config.from_params(dict(params))
+    task = config.task
+    runners = {"train": run_train, "predict": run_predict,
+               "prediction": run_predict, "test": run_predict,
+               "convert_model": run_convert_model, "refit": run_refit,
+               "refit_tree": run_refit, "save_binary": run_save_binary}
+    if task not in runners:
+        log.fatal(f"Unknown task: {task}")
+    runners[task](config, params)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,165 @@
+"""CLI application, native parser, refit, codegen, save_binary.
+
+Mirrors the reference's CLI consistency harness (reference:
+tests/cpp_tests/{train,predict}.conf + test.py comparing prediction files,
+tests/python_package_test/test_consistency.py)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import main as cli_main
+from lightgbm_tpu.native import native_available, parse_text_file
+
+EXAMPLES = "/root/reference/examples"
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    for f in ("binary.train", "binary.test"):
+        src = os.path.join(EXAMPLES, "binary_classification", f)
+        (d / f).write_bytes(open(src, "rb").read())
+    return d
+
+
+def test_native_parser_matches_numpy():
+    path = os.path.join(EXAMPLES, "binary_classification", "binary.train")
+    mat, fmt = parse_text_file(path)
+    ref = np.loadtxt(path)
+    assert fmt == "tsv"
+    np.testing.assert_allclose(mat, ref)
+
+
+def test_native_parser_libsvm():
+    path = os.path.join(EXAMPLES, "lambdarank", "rank.train")
+    mat, fmt = parse_text_file(path)
+    assert fmt == "libsvm"
+    from sklearn.datasets import load_svmlight_file
+    X, y = load_svmlight_file(path, zero_based=False)
+    dense = np.asarray(X.todense())
+    np.testing.assert_allclose(mat[:, 0], y)
+    # raw index j maps to our column j+1; sklearn (1-based) col j-1
+    np.testing.assert_allclose(mat[:, 2:2 + dense.shape[1]], dense)
+
+
+def test_native_parser_csv_missing(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("a,b,c\n1,2.5,3\n4,,6\n7,8,na\n")
+    mat, fmt = parse_text_file(str(p), has_header=True)
+    assert fmt == "csv"
+    assert mat.shape == (3, 3)
+    assert np.isnan(mat[1, 1]) and np.isnan(mat[2, 2])
+
+
+def test_cli_train_predict_consistency(workdir):
+    """CLI-trained model must match Python-trained predictions
+    (the reference's consistency-test contract)."""
+    os.chdir(workdir)
+    cli_main(["task=train", "objective=binary", "data=binary.train",
+              "num_trees=10", "num_leaves=15", "output_model=model.txt",
+              "verbosity=-1"])
+    assert os.path.exists("model.txt")
+    cli_main(["task=predict", "data=binary.test", "input_model=model.txt",
+              "output_result=preds.txt", "verbosity=-1"])
+    cli_preds = np.loadtxt("preds.txt")
+
+    tr = np.loadtxt("binary.train")
+    te = np.loadtxt("binary.test")
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    ds = lgb.Dataset(tr[:, 1:], label=tr[:, 0], params=params)
+    booster = lgb.train(params, ds, num_boost_round=10)
+    py_preds = booster.predict(te[:, 1:])
+    np.testing.assert_allclose(cli_preds, py_preds, rtol=1e-5, atol=1e-7)
+
+
+def test_cli_save_binary_round_trip(workdir):
+    os.chdir(workdir)
+    cli_main(["task=save_binary", "data=binary.train", "verbosity=-1"])
+    assert os.path.exists("binary.train.bin")
+    # training from the .bin file gives identical results to text
+    cli_main(["task=train", "objective=binary", "data=binary.train.bin",
+              "num_trees=5", "output_model=model_bin.txt", "verbosity=-1"])
+    cli_main(["task=train", "objective=binary", "data=binary.train",
+              "num_trees=5", "output_model=model_txt.txt", "verbosity=-1"])
+    m1 = open("model_bin.txt").read().split("feature_importances")[0]
+    m2 = open("model_txt.txt").read().split("feature_importances")[0]
+    assert m1 == m2
+
+
+def test_cli_snapshot(workdir):
+    os.chdir(workdir)
+    cli_main(["task=train", "objective=binary", "data=binary.train",
+              "num_trees=6", "snapshot_freq=2", "output_model=snap.txt",
+              "verbosity=-1"])
+    assert os.path.exists("snap.txt.snapshot_iter_2")
+    assert os.path.exists("snap.txt.snapshot_iter_4")
+    snap = lgb.Booster(model_file="snap.txt.snapshot_iter_4")
+    assert snap.num_trees() == 4
+
+
+def test_refit_improves_on_shifted_labels(workdir):
+    os.chdir(workdir)
+    tr = np.loadtxt("binary.train")
+    X, y = tr[:, 1:], tr[:, 0]
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    booster = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                        num_boost_round=10)
+    # refit leaf values on flipped labels: predictions must track the flip
+    y_flip = 1.0 - y
+    refitted = booster.refit(X, y_flip, decay_rate=0.0)
+    from sklearn.metrics import log_loss
+    orig_ll = log_loss(y_flip, booster.predict(X))
+    refit_ll = log_loss(y_flip, refitted.predict(X))
+    assert refit_ll < orig_ll
+    # structure unchanged: identical leaf assignments
+    np.testing.assert_array_equal(booster.predict(X[:50], pred_leaf=True),
+                                  refitted.predict(X[:50], pred_leaf=True))
+
+
+def test_convert_model_compiles_and_matches(workdir, tmp_path):
+    os.chdir(workdir)
+    cli_main(["task=train", "objective=binary", "data=binary.train",
+              "num_trees=5", "num_leaves=7", "output_model=m5.txt",
+              "verbosity=-1"])
+    cli_main(["task=convert_model", "input_model=m5.txt",
+              "convert_model=m5.cpp", "verbosity=-1"])
+    code = open("m5.cpp").read()
+    assert "PredictTree0" in code and "double Predict(" in code
+    harness = tmp_path / "main.cpp"
+    harness.write_text(
+        '#include <cstdio>\n#include "m5.cpp"\n'
+        "int main(){double f[28];double l;FILE*fp=fopen(\"binary.test\",\"r\");"
+        "for(int r=0;r<20;++r){fscanf(fp,\"%lf\",&l);"
+        "for(int i=0;i<28;++i)fscanf(fp,\"%lf\",&f[i]);"
+        'printf("%.10f\\n",lightgbm_tpu_model::Predict(f));}return 0;}\n')
+    exe = tmp_path / "m5run"
+    proc = subprocess.run(["g++", "-O1", "-std=c++17", str(harness),
+                           f"-I{workdir}", "-o", str(exe)],
+                          capture_output=True, cwd=workdir)
+    if proc.returncode != 0:
+        pytest.fail(f"codegen did not compile: {proc.stderr.decode()[:500]}")
+    out = subprocess.run([str(exe)], capture_output=True, cwd=workdir)
+    cpp = np.array([float(x) for x in out.stdout.split()])
+    booster = lgb.Booster(model_file="m5.txt")
+    te = np.loadtxt("binary.test")
+    np.testing.assert_allclose(cpp, booster.predict(te[:20, 1:]),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_cli_weight_side_file(workdir):
+    os.chdir(workdir)
+    tr = np.loadtxt("binary.train")
+    w = np.ones(len(tr))
+    w[:100] = 5.0
+    np.savetxt("binary.train.weight", w)
+    try:
+        cli_main(["task=train", "objective=binary", "data=binary.train",
+                  "num_trees=3", "output_model=mw.txt", "verbosity=-1"])
+        assert os.path.exists("mw.txt")
+    finally:
+        os.remove("binary.train.weight")
