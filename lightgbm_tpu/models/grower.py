@@ -212,7 +212,8 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
     static_argnames=("max_leaves", "num_bins", "max_depth", "hist_method",
                      "exact", "axis_name", "with_categorical", "with_monotone",
                      "with_interactions", "cegb_mode", "extra_trees",
-                     "use_bynode"))
+                     "use_bynode", "feature_axis_name", "voting",
+                     "vote_top_k"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
               feature_mask: jax.Array, missing_bin: jax.Array, *,
@@ -231,7 +232,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               use_bynode: bool = False,
               bynode_fraction: jax.Array | None = None,
               rng_key: jax.Array | None = None,
-              axis_name: str | None = None
+              axis_name: str | None = None,
+              feature_axis_name: str | None = None,
+              voting: bool = False,
+              vote_top_k: int = 20
               ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
     """Grow one tree. Returns (tree arrays, per-row leaf index, aux state).
 
@@ -260,6 +264,16 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         (data_parallel_tree_learner.cpp:125-152) and histogram ReduceScatter
         (:184-186). All devices then take identical split decisions with no
         further communication.
+      feature_axis_name: feature-parallel mode (reference:
+        feature_parallel_tree_learner.cpp): data replicated, each device
+        searches only its own feature slice (the caller restricts
+        feature_mask), and the per-leaf best splits are allreduce-argmax'd
+        (sync_best_splits) — no histogram communication at all.
+      voting: voting-parallel mode over ``axis_name`` (reference:
+        voting_parallel_tree_learner.cpp PV-tree): rows sharded; each device
+        votes for its local top ``vote_top_k`` features per leaf from LOCAL
+        histograms, the vote elects 2*top_k features globally, and only the
+        elected features' histograms are psum'd before the final search.
     """
     n, f = bins.shape
     L = max_leaves

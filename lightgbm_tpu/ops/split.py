@@ -415,13 +415,42 @@ def monotone_split_penalty(leaf_depth, p: SplitParams):
     return jnp.where(pen > 0.0, out, 1.0)
 
 
+def sync_best_splits(info: SplitInfo, axis_name: str) -> SplitInfo:
+    """Allreduce-argmax of per-leaf best splits across a mesh axis — the SPMD
+    analog of the reference's SyncUpGlobalBestSplit allreduce over serialized
+    SplitInfo blobs (reference: parallel_tree_learner.h:191-214; reducer
+    keeps the destination on ties, i.e. the lower rank wins). Used by the
+    feature-parallel learner where each device searched its own feature
+    slice."""
+    gathered = jax.tree.map(
+        lambda x: jax.lax.all_gather(x, axis_name), info)   # [D, L, ...]
+    gains = gathered.gain                                   # [D, L]
+    ndev = gains.shape[0]
+    # winner = max gain; ties -> lowest device rank (strict-greater reducer)
+    order = jnp.arange(ndev, dtype=jnp.int32)[:, None]
+    best_gain = jnp.max(gains, axis=0)
+    is_best = gains == best_gain[None, :]
+    win = jnp.argmax(jnp.where(is_best, ndev - order, 0), axis=0)  # [L]
+    li = jnp.arange(gains.shape[1])
+    return jax.tree.map(lambda x: x[win, li], gathered)
+
+
+def per_feature_best_gain_key(gains_rev: jax.Array, gains_fwd: jax.Array
+                              ) -> jax.Array:
+    """Best adjusted gain per (leaf, feature) over all numerical candidates
+    — the quantity the voting-parallel learner votes on (reference:
+    voting_parallel_tree_learner.cpp:137-150 local gains for GlobalVoting)."""
+    return jnp.maximum(jnp.max(gains_rev, axis=2), jnp.max(gains_fwd, axis=2))
+
+
 def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
                      leaf_output, leaf_depth, meta: FeatureMeta, p: SplitParams,
                      feature_mask: jax.Array, max_depth: int = -1,
                      with_categorical: bool = False,
                      cat_words: int = CAT_BITSET_WORDS,
                      leaf_min=None, leaf_max=None,
-                     gain_adjust=None, rand_bin=None) -> SplitInfo:
+                     gain_adjust=None, rand_bin=None,
+                     return_feature_gains: bool = False):
     """Best split per leaf over all numerical features.
 
     Args:
@@ -595,6 +624,8 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
         cat_bitset=jnp.zeros((L, cat_words), dtype=jnp.uint32),
     )
     if not with_categorical:
+        if return_feature_gains:
+            return num_info, per_feature_best_gain_key(gain_rev, gain_fwd)
         return num_info
 
     (cgain, cfeat, clg, clh, clc, cbits, cl2) = find_best_cat_splits(
@@ -621,7 +652,7 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
             cond = cond[..., None]
         return jnp.where(cond, cv, nv)
 
-    return SplitInfo(
+    merged = SplitInfo(
         gain=sel(cgain, num_info.gain),
         feature=sel(cfeat, num_info.feature),
         threshold=sel(jnp.zeros((L,), jnp.int32), num_info.threshold),
@@ -637,3 +668,6 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
         is_cat=take_cat,
         cat_bitset=sel(cbits, num_info.cat_bitset),
     )
+    if return_feature_gains:
+        return merged, per_feature_best_gain_key(gain_rev, gain_fwd)
+    return merged
