@@ -287,6 +287,18 @@ class Dataset:
         self.construct()
         return self._missing_bin
 
+    @property
+    def bins_T(self):
+        """Feature-major [F, N] copy of the bin matrix, built lazily: split
+        routing extracts one feature column per split, which on TPU is a
+        contiguous slice here vs a strided read of the whole row-major
+        matrix (reference keeps per-feature bin arrays natively,
+        dense_bin.hpp)."""
+        self.construct()
+        if getattr(self, "_bins_T", None) is None:
+            self._bins_T = jnp.asarray(self.bins.T)
+        return self._bins_T
+
     def num_used_features(self) -> int:
         self.construct()
         return max(len(self.used_features), 1)

@@ -182,6 +182,43 @@ class GBDT:
                 self._cegb_lazy = jnp.asarray(arr)
         self._use_bynode = cfg.feature_fraction_bynode < 1.0
         self._extra_rng_key = jax.random.PRNGKey(cfg.extra_seed)
+        self._setup_tree_learner()
+
+    def _setup_tree_learner(self) -> None:
+        """tree_learner dispatch (reference: TreeLearner factory,
+        tree_learner.h:104 + config.h:205). Non-serial learners run the same
+        jitted grower under a shard_map over the visible device mesh."""
+        cfg = self.config
+        mode = cfg.tree_learner
+        if mode in ("serial", None, ""):
+            self._parallel_grower = None
+            return
+        from ..parallel.learners import PARALLEL_MODES, ParallelGrower
+        if mode not in PARALLEL_MODES:
+            log.fatal(f"Unknown tree learner type {mode}")
+        unsupported = []
+        if self._cegb_mode != "off":
+            unsupported.append("CEGB")
+        if self._with_interactions:
+            unsupported.append("interaction_constraints")
+        if self._use_bynode:
+            unsupported.append("feature_fraction_bynode")
+        if cfg.extra_trees:
+            unsupported.append("extra_trees")
+        if cfg.linear_tree:
+            unsupported.append("linear_tree")
+        if mode == "voting" and self.train_set.has_categorical:
+            unsupported.append("categorical features (voting)")
+        if unsupported:
+            log.fatal(f"tree_learner={mode} does not support: "
+                      f"{', '.join(unsupported)}")
+        existing = getattr(self, "_parallel_grower", None)
+        if existing is not None and existing.mode == mode:
+            return  # keep the compiled cache across reset_config
+        if len(jax.devices()) == 1:
+            log.info(f"tree_learner={mode} with a single device: running the "
+                     f"distributed program on a 1-device mesh")
+        self._parallel_grower = ParallelGrower(mode)
 
     def reset_config(self, config: Config) -> None:
         """Apply updated parameters mid-training (reference: GBDT::ResetConfig,
@@ -270,30 +307,46 @@ class GBDT:
             h = h * (w[:, None] if k > 1 else w)
             mask = (w > 0).astype(jnp.float32)
         no_split = True
+        hm = self._hist_method()
         for c in range(k):
             gc = g[:, c] if k > 1 else g
             hc = h[:, c] if k > 1 else h
             fmask = self._feature_mask()
-            tree, leaf_id, aux = grow_tree(
-                ts.bins, gc, hc, mask,
-                ts.feature_meta, self.split_params, fmask, ts.missing_bin,
-                max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
-                max_depth=cfg.max_depth, hist_method=self._hist_method(),
-                exact=cfg.tree_growth_mode == "exact",
-                with_categorical=ts.has_categorical,
-                with_monotone=self._with_monotone,
-                with_interactions=self._with_interactions,
-                interaction_groups=self._interaction_groups,
-                cegb_mode=self._cegb_mode,
-                cegb_coupled=self._cegb_coupled,
-                cegb_lazy_penalty=self._cegb_lazy,
-                cegb_state=self._cegb_aux,
-                extra_trees=cfg.extra_trees,
-                use_bynode=self._use_bynode,
-                bynode_fraction=jnp.float32(cfg.feature_fraction_bynode)
-                if self._use_bynode else None,
-                rng_key=jax.random.fold_in(self._extra_rng_key,
-                                           self.iter * k + c))
+            iter_key = jax.random.fold_in(self._extra_rng_key,
+                                          self.iter * k + c)
+            if self._parallel_grower is not None:
+                tree, leaf_id, aux = self._parallel_grower(
+                    ts.bins, gc, hc, mask,
+                    ts.feature_meta, self.split_params, fmask, ts.missing_bin,
+                    binsT=ts.bins_T if hm == "onehot" else None,
+                    rng_key=iter_key,
+                    max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
+                    max_depth=cfg.max_depth, hist_method=hm,
+                    exact=cfg.tree_growth_mode == "exact",
+                    with_categorical=ts.has_categorical,
+                    with_monotone=self._with_monotone,
+                    vote_top_k=cfg.top_k)
+            else:
+                tree, leaf_id, aux = grow_tree(
+                    ts.bins, gc, hc, mask,
+                    ts.feature_meta, self.split_params, fmask, ts.missing_bin,
+                    max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
+                    max_depth=cfg.max_depth, hist_method=hm,
+                    binsT=ts.bins_T if hm == "onehot" else None,
+                    exact=cfg.tree_growth_mode == "exact",
+                    with_categorical=ts.has_categorical,
+                    with_monotone=self._with_monotone,
+                    with_interactions=self._with_interactions,
+                    interaction_groups=self._interaction_groups,
+                    cegb_mode=self._cegb_mode,
+                    cegb_coupled=self._cegb_coupled,
+                    cegb_lazy_penalty=self._cegb_lazy,
+                    cegb_state=self._cegb_aux,
+                    extra_trees=cfg.extra_trees,
+                    use_bynode=self._use_bynode,
+                    bynode_fraction=jnp.float32(cfg.feature_fraction_bynode)
+                    if self._use_bynode else None,
+                    rng_key=iter_key)
             if self._cegb_mode != "off":
                 # CEGB feature-used tracking persists across iterations
                 # (cost_effective_gradient_boosting.hpp Init: !init_ reuse)
@@ -305,29 +358,33 @@ class GBDT:
                 first_tree = len(self.trees) < k and self.loaded_iters == 0
                 lin = self._fit_linear_leaves(tree, leaf_id, gc, hc, mask,
                                               first_tree)
-            tree, had_split = self._finalize_tree(tree, leaf_id, c)
+            tree, t_host, had_split = self._finalize_tree(tree, leaf_id, c)
             no_split = no_split and not had_split
             if lin is not None:
-                self._add_tree(tree, leaf_id, c, linear=lin)
+                self._add_tree(tree, leaf_id, c, linear=lin, t_host=t_host)
             else:
-                self._add_tree(tree, leaf_id, c)
+                self._add_tree(tree, leaf_id, c, t_host=t_host)
             self._bias_after_score(c, had_split)
         self.iter += 1
         return no_split
 
     def _hist_method(self) -> str:
-        m = self.config.histogram_method
-        return "scatter" if m == "auto" else m
+        from ..ops.histogram import resolve_method
+        return resolve_method(self.config.histogram_method)
 
     def _sample_weights(self, g, h) -> Optional[jax.Array]:
         """Hook for GOSS-style reweighted sampling; None = use bag mask."""
         return None
 
     def _finalize_tree(self, tree: TreeArrays, leaf_id: jax.Array,
-                       class_idx: int) -> Tuple[TreeArrays, bool]:
-        """RenewTreeOutput + Shrinkage (gbdt.cpp:411-433)."""
+                       class_idx: int) -> Tuple[TreeArrays, TreeArrays, bool]:
+        """RenewTreeOutput + Shrinkage (gbdt.cpp:411-433). Returns the device
+        tree, a host (numpy) mirror fetched in ONE batched transfer (per-array
+        fetches pay a full host round-trip each — ~75ms over a TPU tunnel),
+        and whether the tree has any split."""
         cfg = self.config
-        num_leaves = int(tree.num_leaves)
+        t_host = jax.device_get(tree)
+        num_leaves = int(t_host.num_leaves)
         had_split = num_leaves > 1
         if (had_split and self.objective is not None
                 and self.objective.need_renew_tree_output):
@@ -335,14 +392,18 @@ class GBDT:
             new_values = self.objective.renew_tree_output(
                 np.asarray(leaf_id), score, num_leaves)
             if new_values is not None:
-                lv = np.asarray(tree.leaf_value).copy()
+                lv = np.asarray(t_host.leaf_value).copy()
                 lv[:num_leaves] = new_values
+                t_host = t_host._replace(leaf_value=lv)
                 tree = tree._replace(leaf_value=jnp.asarray(lv))
         lr = self.shrinkage_rate
         tree = tree._replace(leaf_value=tree.leaf_value * lr,
                              node_value=tree.node_value * lr,
                              shrinkage=tree.shrinkage * lr)
-        return tree, had_split
+        t_host = t_host._replace(leaf_value=t_host.leaf_value * lr,
+                                 node_value=t_host.node_value * lr,
+                                 shrinkage=t_host.shrinkage * lr)
+        return tree, t_host, had_split
 
     def _renew_score(self, class_idx: int) -> np.ndarray:
         """Score array used for objective leaf renewal (RF overrides with the
@@ -382,23 +443,25 @@ class GBDT:
         self._stacked_cache = None
 
     def _add_tree(self, tree: TreeArrays, leaf_id: jax.Array, class_idx: int,
-                  linear: Optional[dict] = None) -> None:
+                  linear: Optional[dict] = None,
+                  t_host: Optional[TreeArrays] = None) -> None:
         """Score updates for train (via leaf ids — no traversal needed) and
         valid sets (tree traversal on their binned matrices). ``linear``
         carries a fitted linear-leaf model: per-row train deltas plus the
         const/coeff tables (reference: Tree::AddPredictionToScore linear
-        branch, tree.h)."""
+        branch, tree.h). ``t_host`` is the already-fetched numpy mirror."""
+        from .tree import leaf_values_of_rows
         lr = self.shrinkage_rate
         if linear is not None:
             delta = jnp.asarray(linear["train_delta"] * lr)
         else:
-            delta = tree.leaf_value[leaf_id]
+            delta = leaf_values_of_rows(tree.leaf_value, leaf_id)
         if self.num_tree_per_iteration > 1:
             self.train_score = self.train_score.at[:, class_idx].add(delta)
         else:
             self.train_score = self.train_score + delta
         self.trees.append(tree)
-        self._append_host_tree(tree)
+        self._append_host_tree(t_host if t_host is not None else tree)
         if linear is not None:
             ht = self.host_trees[-1]
             ht.is_linear = True

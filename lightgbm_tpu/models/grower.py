@@ -8,22 +8,26 @@ histogram-pending leaf in ONE full-data pass (ops/histogram.py), and split
 search evaluates all (leaf, feature, threshold) candidates at once
 (ops/split.py).
 
-Growth proceeds in ROUNDS inside a ``lax.while_loop``:
+Growth proceeds in ROUNDS inside a ``lax.while_loop``; each round is either
 
-  round := histogram pass for pending leaves
-        -> vectorized best-split search
-        -> inner while_loop: split leaves in gain order while their
-           histograms are valid (children become histogram-pending).
+  a TILE PASS — one data pass building histograms for a tile of up to
+  ``tile_leaves`` histogram-pending leaves (ops/histogram.py); with
+  ``hist_subtraction`` only the SMALLER child of each sibling pair is
+  computed and the larger is derived as parent - smaller (the reference's
+  subtraction trick, serial_tree_learner.cpp:311-320: the parent's histogram
+  is still resident in the slot the left child inherited, tracked by
+  ``parent_hist``), or
+
+  a SPLIT PHASE (entered when nothing is pending) — vectorized best-split
+  search over all leaves, then an inner while_loop splitting leaves in gain
+  order (children become histogram-pending for the next tile rounds).
 
 Equivalence to the reference's strict leaf-wise order: tree growth is
 order-independent whenever every positive-gain split fits in the
 ``num_leaves`` budget (the set of splits is the gain>0 closure, regardless of
 order). The batched order can differ from strict best-first only in WHICH
 leaves receive the final few splits when the budget binds mid-round — the
-per-leaf split decisions themselves are identical. The reference's
-histogram-subtraction trick (serial_tree_learner.cpp:311-320) is an
-optimization slot here (children are currently both recomputed in the next
-round's single pass).
+per-leaf split decisions themselves are identical.
 
 Guards mirror BeforeFindBestSplit (serial_tree_learner.cpp:282-322): a leaf
 whose count < 2*min_data_in_leaf or hessian sum < 2*min_sum_hessian_in_leaf
@@ -53,7 +57,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops.histogram import build_histograms
+from ..ops.histogram import histogram_tiles
 from ..ops.split import (FeatureMeta, SplitInfo, SplitParams,
                          find_best_splits)
 from .tree import TreeArrays, empty_tree
@@ -85,13 +89,18 @@ class GrowState(NamedTuple):
     used_path: jax.Array     # [L, F] bool (interaction constraints) or [1,1]
     used_split: jax.Array    # [F] bool (CEGB coupled)
     row_used: jax.Array      # [N, F] bool (CEGB lazy) or [1,1]
+    sib: jax.Array           # [L] int32 sibling slot (-1 = none); the pair's
+                             # parent histogram lives at slot min(l, sib[l])
+    parent_hist: jax.Array   # [L] bool: slot's hist holds the PARENT's data
+    done: jax.Array          # bool: a split phase found nothing to split
     best: SplitInfo
     tree: TreeArrays
     num_leaves: jax.Array    # int32
     rounds: jax.Array        # int32
 
 
-def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
+def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
+                 missing_bin: jax.Array,
                  gain_eff: jax.Array, meta: FeatureMeta, *,
                  with_monotone: bool, with_interactions: bool,
                  cegb_lazy: bool) -> Tuple[GrowState, jax.Array]:
@@ -109,8 +118,13 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
     is_cat = best.is_cat[l]
     bitset = best.cat_bitset[l]
 
-    # --- rows of leaf l route left/right
-    col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+    # --- rows of leaf l route left/right. A feature-major ``binsT`` makes
+    # the column extraction a contiguous dynamic slice instead of a strided
+    # read of the whole row-major matrix (matters at 10M+ rows).
+    if binsT is not None:
+        col = jax.lax.dynamic_slice_in_dim(binsT, feat, 1, 0)[0].astype(jnp.int32)
+    else:
+        col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
     mb = missing_bin[feat]
     num_left = jnp.where((col == mb) & (mb >= 0), dleft, col <= thr)
     # categorical: bitset membership (Tree::CategoricalDecision, tree.h:349)
@@ -201,6 +215,10 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
                                    .at[new_leaf].set(new_depth),
         leaf_min=leaf_min, leaf_max=leaf_max,
         used_path=used_path, used_split=used_split, row_used=row_used,
+        # slot l inherits the parent's histogram data (the basis of the
+        # subtraction trick, serial_tree_learner.cpp:311-320)
+        sib=state.sib.at[l].set(new_leaf).at[new_leaf].set(l),
+        parent_hist=state.parent_hist.at[l].set(True).at[new_leaf].set(False),
         num_leaves=state.num_leaves + 1,
     )
     gain_eff = gain_eff.at[l].set(NEG_INF).at[new_leaf].set(NEG_INF)
@@ -212,7 +230,8 @@ def _apply_split(state: GrowState, bins: jax.Array, missing_bin: jax.Array,
     static_argnames=("max_leaves", "num_bins", "max_depth", "hist_method",
                      "exact", "axis_name", "with_categorical", "with_monotone",
                      "with_interactions", "cegb_mode", "extra_trees",
-                     "use_bynode", "feature_axis_name", "voting",
+                     "use_bynode", "tile_leaves", "hist_subtraction",
+                     "feature_axis_name", "feature_shards", "voting",
                      "vote_top_k"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
@@ -233,9 +252,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               bynode_fraction: jax.Array | None = None,
               rng_key: jax.Array | None = None,
               axis_name: str | None = None,
+              binsT: jax.Array | None = None,
+              tile_leaves: int = 42,
+              hist_subtraction: bool = True,
               feature_axis_name: str | None = None,
+              feature_shards: int = 1,
               voting: bool = False,
-              vote_top_k: int = 20
+              vote_top_k: int = 20,
               ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
     """Grow one tree. Returns (tree arrays, per-row leaf index, aux state).
 
@@ -259,27 +282,82 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         used-feature tracking.
       rng_key: PRNG key, consumed when extra_trees or use_bynode.
       axis_name: when set, rows are sharded over this mesh axis (shard_map
-        context): root sums and histograms are psum'd over it — the SPMD
+        context): root sums and histogram tiles are psum'd over it — the SPMD
         analog of the reference data-parallel learner's root allreduce
         (data_parallel_tree_learner.cpp:125-152) and histogram ReduceScatter
         (:184-186). All devices then take identical split decisions with no
         further communication.
-      feature_axis_name: feature-parallel mode (reference:
-        feature_parallel_tree_learner.cpp): data replicated, each device
-        searches only its own feature slice (the caller restricts
-        feature_mask), and the per-leaf best splits are allreduce-argmax'd
-        (sync_best_splits) — no histogram communication at all.
-      voting: voting-parallel mode over ``axis_name`` (reference:
-        voting_parallel_tree_learner.cpp PV-tree): rows sharded; each device
-        votes for its local top ``vote_top_k`` features per leaf from LOCAL
-        histograms, the vote elects 2*top_k features globally, and only the
-        elected features' histograms are psum'd before the final search.
+      binsT: optional [F, N] feature-major copy of ``bins`` for contiguous
+        per-split column extraction during routing (recommended on TPU).
+      tile_leaves: max pending leaves per histogram pass (the "onehot"
+        backend's pass cost is flat in this up to ~42 at 256 bins x 3 stats;
+        scatter/binloop backends use one pass for everything regardless).
+      hist_subtraction: build only the smaller sibling's histogram and derive
+        the larger by subtraction from the parent (the reference's trick,
+        serial_tree_learner.cpp:311-320). Subtraction is exact for the count
+        channel and float32-rounded for grad/hess (the reference subtracts in
+        float64; its GPU path is float32 like ours).
+      feature_axis_name: feature-ownership mesh axis. Set WITHOUT axis_name
+        (rows replicated) = the feature-parallel learner (reference:
+        feature_parallel_tree_learner.cpp:59-78): each device histograms and
+        searches only its own feature slice and the per-leaf best splits are
+        merged with an allreduce-argmax (sync_best_splits). Set EQUAL to
+        axis_name (rows sharded too) = the data-parallel learner with the
+        reference's ReduceScatter communication pattern
+        (data_parallel_tree_learner.cpp:184-186): histogram tiles are
+        psum_scatter'd so each device receives only its owned features'
+        global histograms, searches those, and syncs the best split —
+        1/D the allreduce volume.
+      feature_shards: number of feature slices (= size of feature_axis_name
+        axis); the caller pads features so F divides evenly.
+      voting: voting-parallel learner over ``axis_name`` (reference:
+        voting_parallel_tree_learner.cpp PV-tree): histograms stay LOCAL to
+        each row shard; each device votes its local top ``vote_top_k``
+        features per leaf (local stats, min_data scaled by 1/D,
+        voting_parallel_tree_learner.cpp:62-64), the vote elects 2*top_k
+        features globally (GlobalVoting, :151-182), and only the elected
+        features' histograms are summed across devices before the final
+        search (CopyLocalHistogram, :184+).
     """
     n, f = bins.shape
     L = max_leaves
+    P = min(tile_leaves, L) if hist_method == "onehot" else L
     cat_words = max(1, -(-num_bins // 32))
     cegb_lazy = cegb_mode == "lazy"
     cegb_on = cegb_mode != "off"
+
+    # --- feature-ownership slicing (FP learner, and DP's reduce-scatter)
+    fp_mode = feature_axis_name is not None
+    dp_scatter = fp_mode and (feature_axis_name == axis_name)
+    if voting:
+        assert axis_name is not None, "voting requires row sharding"
+        assert not fp_mode, "voting and feature slicing are exclusive"
+        assert not with_categorical, (
+            "voting-parallel does not support categorical features")
+    if fp_mode:
+        assert f % feature_shards == 0, (
+            f"features {f} not divisible into {feature_shards} shards "
+            f"(pad in the caller)")
+        f_loc = f // feature_shards
+        off = jax.lax.axis_index(feature_axis_name) * f_loc
+        meta_s = FeatureMeta(*(jax.lax.dynamic_slice_in_dim(a, off, f_loc, 0)
+                               for a in meta))
+        missing_bin_s = jax.lax.dynamic_slice_in_dim(missing_bin, off, f_loc, 0)
+        # FP replicates rows and histograms only the local slice; DP-scatter
+        # histograms the full width locally, then psum_scatter assigns slices
+        bins_h = (bins if dp_scatter
+                  else jax.lax.dynamic_slice(bins, (jnp.int32(0), off),
+                                             (n, f_loc)))
+    else:
+        f_loc, off = f, None
+        meta_s, missing_bin_s = meta, missing_bin
+        bins_h = bins
+
+    def slice_f(arr):
+        """Slice a per-feature trailing axis to the local feature shard."""
+        if not fp_mode or arr is None:
+            return arr
+        return jax.lax.dynamic_slice_in_dim(arr, off, f_loc, arr.ndim - 1)
 
     stats = jnp.stack([grad * sample_mask, hess * sample_mask, sample_mask],
                       axis=1).astype(jnp.float32)
@@ -293,12 +371,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     if rng_key is None:
         rng_key = jax.random.PRNGKey(0)
 
+    iota_l = jnp.arange(L, dtype=jnp.int32)
+
     def init_state() -> GrowState:
         zero_best = find_best_splits(  # shape-consistent placeholder (all -inf)
-            jnp.zeros((L, f, num_bins, 3), jnp.float32),
+            jnp.zeros((L, f_loc, num_bins, 3), jnp.float32),
             jnp.zeros((L,)), jnp.zeros((L,)), jnp.zeros((L,)), jnp.zeros((L,)),
-            jnp.zeros((L,), jnp.int32), meta, params,
-            feature_mask if feature_mask.ndim == 1 else feature_mask[:1, :],
+            jnp.zeros((L,), jnp.int32), meta_s, params,
+            jnp.zeros((f_loc,), jnp.float32),
             max_depth, with_categorical=False, cat_words=cat_words)
         if cegb_state is not None:
             used_split = cegb_state.used_split
@@ -308,7 +388,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             row_used = jnp.zeros((n, f) if cegb_lazy else (1, 1), bool)
         return GrowState(
             leaf_id=jnp.zeros((n,), jnp.int32),
-            hist=jnp.zeros((L, f, num_bins, 3), jnp.float32),
+            hist=jnp.zeros((L, f_loc, num_bins, 3), jnp.float32),
             hist_valid=jnp.zeros((L,), bool),
             leaf_dead=jnp.zeros((L,), bool),
             leaf_sum_g=jnp.zeros((L,)).at[0].set(root[0]),
@@ -321,6 +401,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             used_path=jnp.zeros((L, f) if with_interactions else (1, 1), bool),
             used_split=used_split,
             row_used=row_used,
+            sib=jnp.full((L,), -1, jnp.int32),
+            parent_hist=jnp.zeros((L,), bool),
+            done=jnp.bool_(False),
             best=zero_best,
             tree=empty_tree(L, cat_words),
             num_leaves=jnp.int32(1),
@@ -328,11 +411,16 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         )
 
     def active_mask(state: GrowState) -> jax.Array:
-        return jnp.arange(L, dtype=jnp.int32) < state.num_leaves
+        return iota_l < state.num_leaves
+
+    def pending_mask(state: GrowState) -> jax.Array:
+        return (active_mask(state) & ~state.hist_valid & ~state.leaf_dead)
 
     def outer_cond(state: GrowState) -> jax.Array:
-        pending = active_mask(state) & ~state.hist_valid & ~state.leaf_dead
-        return (state.num_leaves < L) & jnp.any(pending) & (state.rounds < L)
+        # keep looping while there is histogram work or more splits may come;
+        # ``done`` is set by a split phase that split nothing
+        more = jnp.any(pending_mask(state)) | ~state.done
+        return (state.num_leaves < L) & more & (state.rounds < 2 * L + 8)
 
     def leaf_feature_mask(state: GrowState, round_key) -> jax.Array:
         """Per-(leaf, feature) validity: global column sampling x interaction
@@ -382,79 +470,179 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                              * cegb_lazy_penalty[None, :] * cnt_unused)
         return delta
 
-    def outer_body(state: GrowState) -> GrowState:
-        active = active_mask(state)
-        # BeforeFindBestSplit guards (serial_tree_learner.cpp:282-322)
-        guard = ((state.leaf_cnt >= 2.0 * params.min_data_in_leaf)
-                 & (state.leaf_sum_h >= 2.0 * params.min_sum_hessian_in_leaf))
-        newly_dead = active & ~state.hist_valid & ~state.leaf_dead & ~guard
-        leaf_dead = state.leaf_dead | newly_dead
-        pending = active & ~state.hist_valid & ~leaf_dead
+    def tile_pass(state: GrowState) -> GrowState:
+        """One histogram pass for a tile of up to P pending leaves, with the
+        larger sibling of each computed pair derived by subtraction."""
+        pending = pending_mask(state)
+        sibc = jnp.maximum(state.sib, 0)
+        has_sib = state.sib >= 0
+        p_slot = jnp.minimum(iota_l, sibc)
+        sib_pending = pending[sibc] & has_sib
+        if hist_subtraction:
+            # compute only the smaller of a derivable pair (reference picks
+            # the smaller child, serial_tree_learner.cpp:311-320)
+            derivable = (pending & sib_pending & state.parent_hist[p_slot])
+            cnt_sib = state.leaf_cnt[sibc]
+            is_smaller = ((state.leaf_cnt < cnt_sib)
+                          | ((state.leaf_cnt == cnt_sib) & (iota_l < sibc)))
+            cand = pending & (~derivable | is_smaller)
+        else:
+            cand = pending
 
-        row_pending = pending[state.leaf_id]
-        new_hist = build_histograms(bins, stats * row_pending[:, None],
-                                    state.leaf_id, L, num_bins,
-                                    method=hist_method)
-        if axis_name is not None:
-            new_hist = jax.lax.psum(new_hist, axis_name)
-        hist = jnp.where(pending[:, None, None, None], new_hist, state.hist)
-        hist_valid = state.hist_valid | pending
+        # first P candidate slots (ascending slot id)
+        order = jnp.argsort(jnp.where(cand, iota_l, L + iota_l))
+        chosen = order[:P].astype(jnp.int32)
+        chosen_ok = cand[chosen]
+        sel = jnp.where(chosen_ok, chosen, -1)
 
+        tile = histogram_tiles(bins_h, stats, state.leaf_id, sel, num_bins,
+                               method=hist_method)
+        if dp_scatter:
+            # the reference DP learner reduce-scatters histograms so each
+            # machine receives only its owned features' global sums
+            # (data_parallel_tree_learner.cpp:184-186) — 1/D the volume of a
+            # full allreduce
+            tile = jax.lax.psum_scatter(tile, axis_name,
+                                        scatter_dimension=1, tiled=True)
+        elif axis_name is not None and not voting:
+            tile = jax.lax.psum(tile, axis_name)
+
+        computed = jnp.zeros((L,), bool).at[chosen].set(chosen_ok)
+        buf = jnp.zeros_like(state.hist).at[chosen].set(
+            jnp.where(chosen_ok[:, None, None, None], tile, 0.0))
+        hist = jnp.where(computed[:, None, None, None], buf, state.hist)
+        if hist_subtraction:
+            # sibling = parent - computed (parent hist still resident at
+            # p_slot in state.hist, untouched by this round's writes)
+            derived = (pending & ~computed & computed[sibc]
+                       & state.parent_hist[p_slot] & has_sib)
+            parent_vals = jnp.take(state.hist, p_slot, axis=0)
+            sib_vals = jnp.take(buf, sibc, axis=0)
+            hist = jnp.where(derived[:, None, None, None],
+                             parent_vals - sib_vals, hist)
+            resolved = computed | derived
+        else:
+            resolved = computed
+        return state._replace(
+            hist=hist,
+            hist_valid=state.hist_valid | resolved,
+            parent_hist=state.parent_hist & ~resolved,
+            rounds=state.rounds + 1)
+
+    def split_phase(state: GrowState) -> GrowState:
         round_key = jax.random.fold_in(rng_key, state.rounds)
-        fmask = leaf_feature_mask(state, round_key)
+        fmask = slice_f(leaf_feature_mask(state, round_key))
         rand_bin = None
         if extra_trees:
             # one random threshold per (leaf, feature) per search
-            # (feature_histogram.hpp USE_RAND rand.NextInt)
+            # (feature_histogram.hpp USE_RAND rand.NextInt); drawn over the
+            # GLOBAL feature space so all shards agree, then sliced
             nbm = jnp.maximum(meta.num_bins - 2, 1)
             u = jax.random.uniform(jax.random.fold_in(round_key, 2), (L, f))
-            rand_bin = (u * nbm[None, :]).astype(jnp.int32)
+            rand_bin = slice_f((u * nbm[None, :]).astype(jnp.int32))
+
+        search_hist = state.hist
+        search_fmask = fmask
+        if voting:
+            # PV-tree election (voting_parallel_tree_learner.cpp:137-182):
+            # local per-feature gains from LOCAL histograms and local leaf
+            # sums (min_data guards scaled by 1/D, :62-64) -> local top-k
+            # vote -> global top-2k electorate -> psum only elected columns
+            lsum = jnp.sum(state.hist[:, 0, :, :], axis=1)     # [L, 3] local
+            ndev = jax.lax.psum(jnp.float32(1.0), axis_name)
+            params_vote = params._replace(
+                min_data_in_leaf=params.min_data_in_leaf / ndev,
+                min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / ndev)
+            _, fgain = find_best_splits(
+                state.hist, lsum[:, 0], lsum[:, 1], lsum[:, 2],
+                state.leaf_output, state.leaf_depth, meta_s, params_vote,
+                fmask, max_depth, with_categorical=False, cat_words=cat_words,
+                rand_bin=rand_bin, return_feature_gains=True)
+            kk = min(vote_top_k, f)
+            k2 = min(2 * vote_top_k, f)
+            rank_local = jnp.argsort(jnp.argsort(-fgain, axis=1), axis=1)
+            local_top = (rank_local < kk) & jnp.isfinite(fgain)
+            votes = jax.lax.psum(local_top.astype(jnp.float32), axis_name)
+            # elect top 2k by vote count, ties to the lower feature index
+            key = votes * (f + 1) - jnp.arange(f, dtype=jnp.float32)[None, :]
+            el_idx = jnp.argsort(-key, axis=1)[:, :k2].astype(jnp.int32)
+            el_onehot = (el_idx[:, :, None]
+                         == jnp.arange(f, dtype=jnp.int32)[None, None, :]
+                         ).astype(jnp.float32)                  # [L, 2k, F]
+            # HIGHEST precision: the selector is exact 0/1 but default TPU
+            # matmul precision would bf16-round the histogram values
+            hist_el = jnp.einsum("lkf,lfbs->lkbs", el_onehot, state.hist,
+                                 precision=jax.lax.Precision.HIGHEST)
+            hist_el = jax.lax.psum(hist_el, axis_name)          # [L, 2k, B, S]
+            search_hist = jnp.einsum("lkf,lkbs->lfbs", el_onehot, hist_el,
+                                     precision=jax.lax.Precision.HIGHEST)
+            elected = jnp.sum(el_onehot, axis=1) > 0.5          # [L, F]
+            fm2 = fmask if fmask.ndim == 2 else jnp.broadcast_to(
+                fmask[None, :], (L, f))
+            search_fmask = (fm2.astype(bool) & elected).astype(jnp.float32)
 
         best = find_best_splits(
-            hist, state.leaf_sum_g, state.leaf_sum_h,
+            search_hist, state.leaf_sum_g, state.leaf_sum_h,
             state.leaf_cnt, state.leaf_output,
-            state.leaf_depth, meta, params,
-            fmask, max_depth,
+            state.leaf_depth, meta_s, params,
+            search_fmask, max_depth,
             with_categorical=with_categorical, cat_words=cat_words,
             leaf_min=state.leaf_min if with_monotone else None,
             leaf_max=state.leaf_max if with_monotone else None,
-            gain_adjust=cegb_adjust(state),
+            gain_adjust=slice_f(cegb_adjust(state)),
             rand_bin=rand_bin)
-        state = state._replace(hist=hist, hist_valid=hist_valid,
-                               leaf_dead=leaf_dead, best=best,
-                               rounds=state.rounds + 1)
+        if fp_mode:
+            # local feature index -> global, then allreduce-argmax of the
+            # per-leaf bests (reference: SyncUpGlobalBestSplit,
+            # parallel_tree_learner.h:191-214)
+            from ..ops.split import sync_best_splits
+            best = best._replace(feature=best.feature + off)
+            best = sync_best_splits(best, feature_axis_name)
+        num_leaves_before = state.num_leaves
+        state = state._replace(best=best, rounds=state.rounds + 1)
 
-        gain_eff = jnp.where(active & hist_valid & ~leaf_dead, best.gain, NEG_INF)
+        gain_eff = jnp.where(active_mask(state) & state.hist_valid
+                             & ~state.leaf_dead, best.gain, NEG_INF)
 
         apply_kw = dict(with_monotone=with_monotone,
                         with_interactions=with_interactions,
                         cegb_lazy=cegb_lazy)
 
         if exact:
-            # strict best-first: one split per round, then recompute children
+            # strict best-first: one split per phase, then recompute children
             def do_split(carry):
                 st, ge = carry
-                return _apply_split(st, bins, missing_bin, ge, meta, **apply_kw)
+                return _apply_split(st, bins, binsT, missing_bin, ge, meta,
+                                    **apply_kw)
 
             state, _ = jax.lax.cond(
                 (state.num_leaves < L) & (jnp.max(gain_eff) > 0.0),
                 do_split, lambda c: c, (state, gain_eff))
-            # mark all remaining splittable-but-unsplit leaves as needing
-            # nothing: their hists stay valid; loop continues via pending
-            # children. If nothing was split and nothing is pending, the
-            # outer cond ends the loop.
-            return state
+        else:
+            def inner_cond(carry):
+                st, ge = carry
+                return (st.num_leaves < L) & (jnp.max(ge) > 0.0)
 
-        def inner_cond(carry):
-            st, ge = carry
-            return (st.num_leaves < L) & (jnp.max(ge) > 0.0)
+            def inner_body(carry):
+                st, ge = carry
+                return _apply_split(st, bins, binsT, missing_bin, ge, meta,
+                                    **apply_kw)
 
-        def inner_body(carry):
-            st, ge = carry
-            return _apply_split(st, bins, missing_bin, ge, meta, **apply_kw)
+            state, _ = jax.lax.while_loop(inner_cond, inner_body,
+                                          (state, gain_eff))
+        return state._replace(done=state.num_leaves == num_leaves_before)
 
-        state, _ = jax.lax.while_loop(inner_cond, inner_body, (state, gain_eff))
-        return state
+    def outer_body(state: GrowState) -> GrowState:
+        # BeforeFindBestSplit guards (serial_tree_learner.cpp:282-322): a
+        # leaf failing the 2x min-data/min-hessian check is never
+        # histogrammed and never splittable
+        active = active_mask(state)
+        guard = ((state.leaf_cnt >= 2.0 * params.min_data_in_leaf)
+                 & (state.leaf_sum_h >= 2.0 * params.min_sum_hessian_in_leaf))
+        newly_dead = active & ~state.hist_valid & ~state.leaf_dead & ~guard
+        state = state._replace(leaf_dead=state.leaf_dead | newly_dead)
+        return jax.lax.cond(jnp.any(pending_mask(state)),
+                            tile_pass, split_phase, state)
 
     state = jax.lax.while_loop(outer_cond, outer_body, init_state())
     return state.tree, state.leaf_id, GrowAux(state.used_split, state.row_used)

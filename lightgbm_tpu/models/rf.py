@@ -96,30 +96,38 @@ class RF(GBDT):
                           else self._const_score[:, class_idx], dtype=np.float64)
 
     def _finalize_tree(self, tree: TreeArrays, leaf_id, class_idx: int
-                       ) -> Tuple[TreeArrays, bool]:
-        tree, had_split = super()._finalize_tree(tree, leaf_id, class_idx)
+                       ) -> Tuple[TreeArrays, TreeArrays, bool]:
+        tree, t_host, had_split = super()._finalize_tree(tree, leaf_id,
+                                                         class_idx)
         bias = self.init_scores[class_idx]
         if abs(bias) > 1e-15:
             if had_split:
                 tree = tree._replace(leaf_value=tree.leaf_value + bias,
                                      node_value=tree.node_value + bias)
+                t_host = t_host._replace(
+                    leaf_value=t_host.leaf_value + bias,
+                    node_value=t_host.node_value + bias)
             else:
                 # splitless tree becomes the constant init tree (rf.hpp:131
                 # AsConstantTree path)
                 tree = tree._replace(leaf_value=tree.leaf_value.at[0].set(bias))
-        return tree, had_split
+                lv = np.asarray(t_host.leaf_value).copy()
+                lv[0] = bias
+                t_host = t_host._replace(leaf_value=lv)
+        return tree, t_host, had_split
 
     def _bias_after_score(self, class_idx: int, had_split: bool) -> None:
         """RF folds its bias per-tree in _finalize_tree (BEFORE the running
         mean update — the mean must include it); no post-score fold."""
         self.tree_bias.append(0.0)
 
-    def _add_tree(self, tree: TreeArrays, leaf_id, class_idx: int) -> None:
+    def _add_tree(self, tree: TreeArrays, leaf_id, class_idx: int,
+                  linear=None, t_host=None) -> None:
         """Running-mean score update (rf.hpp:139-141):
         score <- (score * m + tree_pred) / (m + 1)."""
-        from .tree import predict_value_bins
+        from .tree import leaf_values_of_rows, predict_value_bins
         m = float(self.iter)
-        delta = tree.leaf_value[leaf_id]
+        delta = leaf_values_of_rows(tree.leaf_value, leaf_id)
         k = self.num_tree_per_iteration
         if k > 1:
             col = (self.train_score[:, class_idx] * m + delta) / (m + 1.0)
@@ -134,7 +142,7 @@ class RF(GBDT):
             else:
                 self._valid_scores[i] = (self._valid_scores[i] * m + vdelta) / (m + 1.0)
         self.trees.append(tree)
-        self._append_host_tree(tree)
+        self._append_host_tree(t_host if t_host is not None else tree)
         self._stacked_cache = None
 
     def rollback_one_iter(self) -> None:

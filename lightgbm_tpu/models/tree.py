@@ -128,6 +128,32 @@ def predict_value_bins(tree: TreeArrays, bins: jax.Array,
     return tree.leaf_value[leaf]
 
 
+def leaf_values_of_rows(leaf_value: jax.Array, leaf_id: jax.Array,
+                        block: int = 65536) -> jax.Array:
+    """Per-row tree output ``leaf_value[leaf_id]`` without a gather.
+
+    XLA's gather from a small table costs ~90ms for 10M rows on a v5e (it
+    serializes); a blocked compare x matmul runs at memory bandwidth. Used
+    for the training-score update (the analog of Tree::AddPredictionToScore,
+    tree.h, which indexes the data partition instead).
+    """
+    if jax.default_backend() != "tpu":
+        return leaf_value[leaf_id]
+    n = leaf_id.shape[0]
+    l = leaf_value.shape[0]
+    c = min(block, -(-n // 512) * 512)
+    pad = -n % c
+    lid = jnp.pad(leaf_id, (0, pad), constant_values=-1) if pad else leaf_id
+    iota = jnp.arange(l, dtype=jnp.int32)
+
+    def body(_, lid_blk):
+        oh = (lid_blk[:, None] == iota[None, :]).astype(jnp.float32)
+        return _, oh @ leaf_value
+
+    _, vals = jax.lax.scan(body, 0, lid.reshape(-1, c))
+    return vals.reshape(-1)[:n]
+
+
 def stack_trees(trees: List[TreeArrays]) -> TreeArrays:
     """Stack per-tree arrays with a leading T axis for scan-based ensemble
     prediction (the analog of GBDT::PredictRaw's per-tree loop,
@@ -155,7 +181,9 @@ class HostTree:
     def __init__(self, arrays: TreeArrays, real_thresholds: np.ndarray,
                  feature_indices: np.ndarray,
                  missing_types: np.ndarray | None = None):
-        t = jax.tree.map(np.asarray, arrays)
+        # one batched device_get: per-array fetches each pay a full host
+        # round-trip (~75ms over a TPU tunnel), ~18x per tree
+        t = jax.device_get(arrays)
         self.num_leaves = int(t.num_leaves)
         n = max(self.num_leaves - 1, 0)
         self.split_feature = t.node_feature[:n].astype(np.int32)

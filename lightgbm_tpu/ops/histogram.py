@@ -2,18 +2,25 @@
 
 The TPU analog of the reference's histogram construction hot loop
 (reference: src/io/dense_bin.hpp:98-141 ``ConstructHistogramInner`` on CPU and
-src/treelearner/kernels/histogram_16_64_256.cu on CUDA). Instead of
-scatter-adds with atomics, the data lives as a dense binned matrix
-``bins[N, F]`` and histograms are built for ALL pending leaves in a single
-pass keyed by ``(leaf, feature, bin)``.
+src/treelearner/kernels/histogram_16_64_256.cu on CUDA). The data lives as a
+dense binned matrix ``bins[N, F]`` and histograms are built for a TILE of
+pending leaves in a single data pass keyed by ``(tile slot, feature, bin)``.
 
 Backends (selected by ``method``):
 
-- ``"scatter"``: one flat XLA scatter-add. Exact, portable; XLA lowers it to
-  sort+segment-sum on TPU. Reference semantics but no atomics.
-- ``"binloop"``: loop over bin values with masked einsum reductions — turns
-  the scatter into ``B`` dense compare+matmul steps (VPU/MXU friendly, no
-  scatter at all).
+- ``"onehot"`` (TPU default): scan over fixed-size row blocks; each block
+  builds a transient bin one-hot ``[C, F*B]`` and a leaf-slot one-hot x stats
+  ``[C, P*S]`` and contracts them on the MXU. No scatter at all — measured on
+  v5e, XLA's scatter-add runs at ~0.06 G updates/s (sequential lowering)
+  while this pass is memory/pipeline-bound at ~4 G elem/s nearly independent
+  of the tile width P (the one-hot materialization dominates), which is why
+  a tile of ~42 leaves costs the same as one. This is the TPU re-design of
+  the CUDA sub-histogram kernels (histogram_16_64_256.cu:16-120): their
+  shared-memory atomics become a dense one-hot contraction.
+- ``"scatter"``: one flat scatter-add — the right backend on CPU hosts
+  (tests, small data), pathological on TPU.
+- ``"binloop"``: loop over bin values with masked einsum reductions; kept for
+  small problems and cross-checks.
 
 Accumulation is float32 (the reference CPU path uses float64 ``hist_t``
 (bin.h:32); its GPU path defaults to float32 ``gpu_use_dp=false`` with
@@ -100,3 +107,95 @@ def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     """Histogram subtraction trick: sibling = parent - child
     (reference: serial_tree_learner.cpp:311-320, feature_histogram.hpp:79)."""
     return parent - child
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def resolve_method(method: str) -> str:
+    """Map ``histogram_method="auto"`` to the platform's fast backend
+    (the analog of the reference's col-wise/row-wise auto benchmark,
+    dataset.cpp:591-689 TestMultiThreadingMethod — here the choice is
+    platform-structural: scatter-add is fast on CPU hosts and pathologically
+    serialized on TPU, where the one-hot contraction wins)."""
+    if method == "auto":
+        return "onehot" if jax.default_backend() == "tpu" else "scatter"
+    return method
+
+
+def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
+                    sel: jax.Array, num_bins: int, method: str = "onehot",
+                    block: int = 16384) -> jax.Array:
+    """Histograms for a TILE of leaves.
+
+    Slot ``p`` of the output accumulates the rows whose ``leaf_ids`` equals
+    ``sel[p]``; ``sel`` entries < 0 are inactive slots (zero output). This is
+    the unit the grower calls once per tile round — on TPU its cost is nearly
+    independent of the tile width, so one call covers up to ~42 pending
+    leaves.
+
+    Args:
+      bins: [N, F] integer bin matrix.
+      stats: [N, S] per-row statistics (grad, hess, count-weight), already
+        masked for bagging.
+      leaf_ids: [N] leaf slot of each row.
+      sel: [P] int32 leaf ids selected into this tile (-1 = inactive slot).
+      num_bins: bins per feature B (static).
+
+    Returns:
+      [P, F, B, S] float32 histogram.
+    """
+    n, f = bins.shape
+    p = sel.shape[0]
+    s = stats.shape[1]
+
+    if method == "onehot":
+        c = min(block, _round_up(max(n, 1), 512))
+        pad = _round_up(n, c) - n
+        if pad:
+            bins = jnp.pad(bins, ((0, pad), (0, 0)))
+            stats = jnp.pad(stats, ((0, pad), (0, 0)))
+            leaf_ids = jnp.pad(leaf_ids, (0, pad), constant_values=-1)
+        nblk = (n + pad) // c
+        iota_b = jnp.arange(num_bins, dtype=jnp.int32)
+
+        def body(acc, xs):
+            b, st, lid = xs
+            oh = (b.astype(jnp.int32)[:, :, None] == iota_b[None, None, :]
+                  ).astype(jnp.float32).reshape(c, f * num_bins)
+            lo = (lid[:, None] == sel[None, :]).astype(jnp.float32)  # [C, P]
+            rhs = (lo[:, :, None] * st[:, None, :]).reshape(c, p * s)
+            # HIGHEST precision: TPU matmuls otherwise truncate inputs to
+            # bf16, corrupting grad/hess sums ~0.5% (the one-hot side is
+            # exact either way; counts accumulate exactly in f32 regardless)
+            h = jax.lax.dot_general(oh, rhs, (((0,), (0,)), ((), ())),
+                                    precision=jax.lax.Precision.HIGHEST,
+                                    preferred_element_type=jnp.float32)
+            return acc + h, None
+
+        h, _ = jax.lax.scan(
+            body, jnp.zeros((f * num_bins, p * s), jnp.float32),
+            (bins.reshape(nblk, c, f), stats.reshape(nblk, c, s),
+             leaf_ids.reshape(nblk, c)))
+        return h.reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
+
+    # slot index per row: position of its leaf in sel, or P (dropped)
+    eq = leaf_ids[:, None] == sel[None, :]                        # [N, P]
+    if method == "scatter":
+        slot = jnp.where(jnp.any(eq, axis=1),
+                         jnp.argmax(eq, axis=1).astype(jnp.int32),
+                         jnp.int32(p))
+        flat_idx = (slot[:, None] * f
+                    + jnp.arange(f, dtype=jnp.int32)[None, :]) * num_bins \
+            + bins.astype(jnp.int32)
+        contrib = jnp.broadcast_to(stats.astype(jnp.float32)[:, None, :],
+                                   (n, f, s))
+        hist = jnp.zeros(((p + 1) * f * num_bins, s), dtype=jnp.float32)
+        hist = hist.at[flat_idx.reshape(-1)].add(contrib.reshape(-1, s))
+        return hist.reshape(p + 1, f, num_bins, s)[:p]
+    elif method == "binloop":
+        onehot = eq.astype(jnp.float32)
+        return histogram_binloop(bins, stats.astype(jnp.float32), onehot,
+                                 num_bins)
+    raise ValueError(f"unknown histogram method: {method}")

@@ -51,7 +51,7 @@ def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
                  sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
                  feature_mask: jax.Array, missing_bin: jax.Array, *,
                  max_leaves: int, num_bins: int, max_depth: int = -1,
-                 hist_method: str = "scatter",
+                 hist_method: str = "auto",
                  exact: bool = False,
                  with_categorical: bool = False,
                  axis: str = "data") -> Tuple[TreeArrays, jax.Array]:
@@ -70,10 +70,11 @@ def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
         hess = jnp.concatenate([hess, jnp.zeros((pad,), hess.dtype)])
         sample_mask = jnp.concatenate([sample_mask, jnp.zeros((pad,), sample_mask.dtype)])
 
+    from ..ops.histogram import resolve_method
     grow = functools.partial(
         grow_tree, max_leaves=max_leaves, num_bins=num_bins,
-        max_depth=max_depth, hist_method=hist_method, exact=exact,
-        with_categorical=with_categorical, axis_name=axis)
+        max_depth=max_depth, hist_method=resolve_method(hist_method),
+        exact=exact, with_categorical=with_categorical, axis_name=axis)
 
     from ..models.grower import GrowAux
     shard = jax.shard_map(

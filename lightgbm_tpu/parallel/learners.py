@@ -1,0 +1,150 @@
+"""tree_learner dispatch: serial / data / feature / voting over a device mesh.
+
+The analog of the reference's TreeLearner factory
+(reference: include/LightGBM/tree_learner.h:104 ``CreateTreeLearner``:
+(serial|feature|data|voting) x device). Here every distributed mode is the
+SAME jitted grower (models/grower.py) under a ``shard_map`` with a
+mode-specific sharding layout and collective pattern:
+
+- ``data``: rows sharded; histogram tiles ``psum_scatter``'d over feature
+  ownership, owner search, best-split allreduce-argmax (reference:
+  data_parallel_tree_learner.cpp:184-186 ReduceScatter + HistogramSumReducer,
+  parallel_tree_learner.h:191 SyncUpGlobalBestSplit).
+- ``feature``: rows replicated, features sliced; no histogram communication,
+  only the best-split sync (reference:
+  feature_parallel_tree_learner.cpp:59-78).
+- ``voting``: rows sharded; local top-k vote elects 2k features per leaf and
+  only those columns are summed (reference:
+  voting_parallel_tree_learner.cpp:151-182 GlobalVoting).
+
+The mesh is a 1-D enumeration of the visible devices (multi-host: initialize
+``jax.distributed`` before constructing the Booster and every process sees
+the global mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.split import FeatureMeta
+from ..models.grower import GrowAux, grow_tree
+from .data_parallel import make_mesh
+
+PARALLEL_MODES = ("data", "feature", "voting")
+
+
+def _pad_rows(n_pad, *arrays):
+    out = []
+    for a in arrays:
+        if a is None:
+            out.append(None)
+        elif a.ndim == 1:
+            out.append(jnp.pad(a, (0, n_pad)))
+        else:
+            out.append(jnp.pad(a, ((0, n_pad), (0, 0))))
+    return out
+
+
+def _pad_features(meta: FeatureMeta, f_pad: int) -> FeatureMeta:
+    """Pad per-feature metadata with inert features (2 bins, no missing,
+    numerical, unconstrained) — they are masked off via feature_mask."""
+    return FeatureMeta(
+        num_bins=jnp.pad(meta.num_bins, (0, f_pad), constant_values=2),
+        missing_type=jnp.pad(meta.missing_type, (0, f_pad)),
+        default_bin=jnp.pad(meta.default_bin, (0, f_pad)),
+        is_categorical=jnp.pad(meta.is_categorical, (0, f_pad)),
+        monotone=jnp.pad(meta.monotone, (0, f_pad)),
+        penalty=jnp.pad(meta.penalty, (0, f_pad), constant_values=1.0),
+    )
+
+
+class ParallelGrower:
+    """Caches one shard_map'd grower per static configuration so repeated
+    boosting iterations reuse the compiled program (the reference constructs
+    its tree learner once in GBDT::Init, gbdt.cpp:49-138)."""
+
+    def __init__(self, mode: str, mesh: Optional[Mesh] = None,
+                 axis: str = "shard"):
+        assert mode in PARALLEL_MODES, mode
+        self.mode = mode
+        self.axis = axis
+        self.mesh = mesh if mesh is not None else make_mesh(axis=axis)
+        self.ndev = self.mesh.shape[axis]
+        self._cache = {}
+
+    def _build(self, has_binsT: bool, grow_kwargs: tuple):
+        axis = self.axis
+        kw = dict(grow_kwargs)
+        if self.mode == "data":
+            kw.update(axis_name=axis, feature_axis_name=axis,
+                      feature_shards=self.ndev)
+        elif self.mode == "feature":
+            kw.update(feature_axis_name=axis, feature_shards=self.ndev)
+        else:  # voting
+            kw.update(axis_name=axis, voting=True)
+
+        rows_sharded = self.mode in ("data", "voting")
+        row = P(axis) if rows_sharded else P()
+        row2 = P(axis, None) if rows_sharded else P()
+        colT = P(None, axis) if rows_sharded else P()
+
+        if has_binsT:
+            def fn(bins, grad, hess, mask, meta, params, fmask, missing_bin,
+                   binsT, rng_key):
+                return grow_tree(bins, grad, hess, mask, meta, params, fmask,
+                                 missing_bin, binsT=binsT, rng_key=rng_key,
+                                 **kw)
+            in_specs = (row2, row, row, row, P(), P(), P(), P(), colT, P())
+        else:
+            def fn(bins, grad, hess, mask, meta, params, fmask, missing_bin,
+                   rng_key):
+                return grow_tree(bins, grad, hess, mask, meta, params, fmask,
+                                 missing_bin, rng_key=rng_key, **kw)
+            in_specs = (row2, row, row, row, P(), P(), P(), P(), P())
+        out_specs = (P(), row, GrowAux(P(), P()))
+        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
+    def __call__(self, bins, grad, hess, sample_mask, meta, params,
+                 feature_mask, missing_bin, *, binsT=None, rng_key=None,
+                 **grow_kwargs):
+        n, f = bins.shape
+        d = self.ndev
+        # pad rows (data/voting shard rows) and features (data/feature
+        # shard feature ownership) to multiples of the mesh size
+        n_pad = (-n) % d if self.mode in ("data", "voting") else 0
+        f_pad = (-f) % d if self.mode in ("data", "feature") else 0
+        if n_pad:
+            bins, grad, hess, sample_mask = _pad_rows(
+                n_pad, bins, grad, hess, sample_mask)
+            if binsT is not None:
+                binsT = jnp.pad(binsT, ((0, 0), (0, n_pad)))
+        if f_pad:
+            bins = jnp.pad(bins, ((0, 0), (0, f_pad)))
+            meta = _pad_features(meta, f_pad)
+            feature_mask = jnp.pad(feature_mask, (0, f_pad))
+            missing_bin = jnp.pad(missing_bin, (0, f_pad),
+                                  constant_values=-1)
+            if binsT is not None:
+                binsT = jnp.pad(binsT, ((0, f_pad), (0, 0)))
+        if rng_key is None:
+            rng_key = jax.random.PRNGKey(0)
+
+        key = (binsT is not None, tuple(sorted(grow_kwargs.items())))
+        shard = self._cache.get(key)
+        if shard is None:
+            shard = self._build(binsT is not None,
+                                tuple(sorted(grow_kwargs.items())))
+            self._cache[key] = shard
+        args = (bins, grad, hess, sample_mask, meta, params, feature_mask,
+                missing_bin)
+        if binsT is not None:
+            args += (binsT,)
+        tree, leaf_id, aux = shard(*args, rng_key)
+        return tree, leaf_id[:n], aux
