@@ -44,6 +44,47 @@ def _to_2d_float(data) -> np.ndarray:
     return arr
 
 
+def _is_scipy_sparse(data) -> bool:
+    """scipy CSR/CSC/COO — handled without densifying (the reference's
+    sparse-input path, c_api.h LGBM_DatasetCreateFromCSR/CSC)."""
+    return (hasattr(data, "tocsc") and hasattr(data, "nnz")
+            and not hasattr(data, "values"))
+
+
+def _load_forced_bins(config: Config, num_features: int,
+                      categorical: Sequence[int]) -> Dict[int, List[float]]:
+    """Forced bin upper bounds from JSON (reference:
+    DatasetLoader::GetForcedBins, dataset_loader.cpp:1373-1408; format
+    [{"feature": i, "bin_upper_bound": [...]}, ...])."""
+    if not config.forcedbins_filename:
+        return {}
+    import json
+    try:
+        with open(config.forcedbins_filename) as fh:
+            arr = json.load(fh)
+    except OSError:
+        log.warning(f"Could not open {config.forcedbins_filename}. "
+                    f"Will ignore.")
+        return {}
+    cats = set(int(c) for c in categorical)
+    out: Dict[int, List[float]] = {}
+    for entry in arr:
+        j = int(entry["feature"])
+        if j >= num_features:
+            log.fatal(f"forced bins feature index {j} out of range")
+        if j in cats:
+            log.warning(f"Feature {j} is categorical. Will ignore forced "
+                        f"bins for this feature.")
+            continue
+        bounds = [float(v) for v in entry["bin_upper_bound"]]
+        deduped = []
+        for v in bounds:      # remove consecutive duplicates (reference)
+            if not deduped or v != deduped[-1]:
+                deduped.append(v)
+        out[j] = deduped
+    return out
+
+
 class Dataset:
     """Training/validation data container (reference: basic.py Dataset)."""
 
@@ -74,6 +115,8 @@ class Dataset:
         # are mapped to these codes at train AND predict time (reference:
         # basic.py:504-568 pandas_categorical capture)
         self.pandas_categorical: Dict[int, list] = {}
+        # EFB bundles (bundling.py): None = plain per-feature columns
+        self.bundles = None
 
     # ------------------------------------------------------------ fields
     def set_label(self, label):
@@ -176,6 +219,12 @@ class Dataset:
         if self._constructed:
             return self
         config = Config.from_params(self.params)
+        if _is_scipy_sparse(self.data) or (
+                self.reference is not None
+                and getattr(self.reference.construct(), "bundles", None)
+                is not None):
+            return self._construct_sparse(config)
+        self.bundles = None
         if self.reference is not None:
             self.pandas_categorical = self.reference.construct().pandas_categorical
         raw = self._pandas_to_codes(self.data)
@@ -201,7 +250,9 @@ class Dataset:
             self.has_categorical = ref.has_categorical
         else:
             cats = self._resolve_categorical(self.num_total_features, self._feature_names)
-            self.mappers = binning.find_bin_mappers(X, config, cats)
+            forced = _load_forced_bins(config, self.num_total_features, cats)
+            self.mappers = binning.find_bin_mappers(X, config, cats,
+                                                    forced_bounds=forced)
             self.used_features = np.array(
                 [j for j, m in enumerate(self.mappers) if not m.is_trivial],
                 dtype=np.int32)
@@ -229,6 +280,284 @@ class Dataset:
         log.info(f"Number of data points in the train set: {self.num_data}, "
                  f"number of used features: {len(self.used_features)}")
         return self
+
+    # ------------------------------------------------- sparse + EFB path
+    def _construct_sparse(self, config: Config) -> "Dataset":
+        """Construct from scipy sparse input (and/or with EFB bundling)
+        without ever densifying the raw matrix (reference: sparse_bin.hpp
+        storage + dataset.cpp:239 FastFeatureBundling; here sparse features
+        bundle into shared dense device columns, which is the TPU-correct
+        storage: a dense [N, G] bin matrix with G ~ bundles, not features)."""
+        if config.linear_tree:
+            log.fatal("linear_tree is not supported with sparse input")
+        sparse = _is_scipy_sparse(self.data)
+        if sparse:
+            X = self.data.tocsc()
+        else:
+            X = _to_2d_float(self._pandas_to_codes(self.data))
+        self.num_data, self.num_total_features = X.shape
+        if self.feature_name == "auto" or self.feature_name is None:
+            self._feature_names = [f"Column_{i}"
+                                   for i in range(self.num_total_features)]
+        else:
+            self._feature_names = list(self.feature_name)
+
+        if self.reference is not None:
+            ref = self.reference.construct()
+            if self.num_total_features != ref.num_total_features:
+                log.fatal("validation data has different number of features")
+            for attr in ("mappers", "used_features", "_feature_meta",
+                         "_missing_bin", "max_num_bins", "has_categorical",
+                         "bundles", "_bundle_meta", "_owner_orig",
+                         "_thr_fwd", "_thr_rev", "pandas_categorical"):
+                setattr(self, attr, getattr(ref, attr, None))
+        else:
+            cats = self._resolve_categorical(self.num_total_features,
+                                             self._feature_names)
+            sample = binning.sample_indices(
+                self.num_data, config.bin_construct_sample_cnt,
+                config.data_random_seed)
+            if sparse:
+                Xs = self.data.tocsr()[sample].tocsc()
+            else:
+                Xs = X[sample]
+            forced = _load_forced_bins(config, self.num_total_features, cats)
+            self.mappers = self._fit_mappers_from_sample(Xs, len(sample),
+                                                         config, cats, forced)
+            self.used_features = np.array(
+                [j for j, m in enumerate(self.mappers) if not m.is_trivial],
+                dtype=np.int32)
+            if len(self.used_features) == 0:
+                log.warning("There are no meaningful features, as all feature"
+                            " values are constant.")
+            self._run_bundling(Xs, len(sample), config)
+            self._build_feature_meta_bundled(config)
+
+        bins_np = self._bin_columns(X)
+        dtype = np.uint8 if self.max_num_bins <= 256 else np.int32
+        self.bins = jnp.asarray(bins_np.astype(dtype))
+        self.raw_data_np = None
+        self._constructed = True
+        if self.free_raw_data:
+            self.data = None
+        g = len(self.bundles) if self.bundles else 0
+        nb_total = sum(b.num_bin for b in (self.bundles or []))
+        log.info(f"Total Bins {nb_total}")
+        log.info(f"Number of data points in the train set: {self.num_data}, "
+                 f"number of used features: {len(self.used_features)}"
+                 + (f" (bundled into {g} columns)"
+                    if g and g != len(self.used_features) else ""))
+        return self
+
+    def _fit_mappers_from_sample(self, Xs, total, config, cats,
+                                 forced_bounds=None):
+        """Per-feature BinMapper from a row sample; for CSC input only the
+        nonzeros are touched (zeros implied by the count, the reference's
+        sparse sampling protocol, dataset_loader.cpp:953+)."""
+        sparse = _is_scipy_sparse(Xs)
+        filter_cnt = binning.filter_cnt_for_sample(config, total,
+                                                   self.num_data)
+        cat_set = set(int(c) for c in cats)
+        mappers = []
+        for j in range(self.num_total_features):
+            if sparse:
+                vals = np.asarray(
+                    Xs.data[Xs.indptr[j]:Xs.indptr[j + 1]], dtype=np.float64)
+            else:
+                col = np.asarray(Xs[:, j], dtype=np.float64)
+                vals = col[col != 0.0]
+            mappers.append(binning.fit_mapper_for_column(
+                j, vals, total, config, cat_set, filter_cnt, forced_bounds))
+        return mappers
+
+    def _run_bundling(self, Xs, total, config) -> None:
+        """Greedy EFB over the bundle-eligible used features
+        (reference: dataset.cpp:239 FastFeatureBundling)."""
+        from .bundling import Bundle, fast_feature_bundling
+        used = self.used_features
+        mc = list(config.monotone_constraints or [])
+        fc = list(config.feature_contri or [])
+        sparse = _is_scipy_sparse(Xs)
+        num_bins = []
+        nonzero_rows = []
+        bundle_ok = np.zeros(len(used), dtype=bool)
+        for i, j in enumerate(used):
+            m = self.mappers[j]
+            num_bins.append(m.num_bin)
+            ok = (config.enable_bundle
+                  and m.bin_type == binning.BIN_TYPE_NUMERICAL
+                  and m.missing_type != binning.MISSING_NAN
+                  and m.most_freq_bin == m.default_bin
+                  and not (j < len(mc) and int(mc[j]) != 0)
+                  and not (j < len(fc) and float(fc[j]) != 1.0))
+            if not ok:
+                nonzero_rows.append(None)
+                continue
+            if sparse:
+                rows = Xs.indices[Xs.indptr[j]:Xs.indptr[j + 1]]
+                vals = np.asarray(Xs.data[Xs.indptr[j]:Xs.indptr[j + 1]],
+                                  dtype=np.float64)
+            else:
+                col = np.asarray(Xs[:, j], dtype=np.float64)
+                rows = np.nonzero(col != 0.0)[0]
+                vals = col[rows]
+            b = m.values_to_bins(vals)
+            nonzero_rows.append(np.asarray(rows)[b != m.most_freq_bin])
+            bundle_ok[i] = True
+        self.bundles = fast_feature_bundling(nonzero_rows, num_bins,
+                                             bundle_ok, total)
+
+    def _build_feature_meta_bundled(self, config: Config) -> None:
+        """Per-COLUMN metadata for bundled datasets: each device column is a
+        bundle (or a single feature); bundle columns get segment arrays for
+        the EFB-aware split search (ops/split.py BundleMeta)."""
+        from .ops.split import BundleMeta
+        used = self.used_features
+        bundles = self.bundles
+        g = max(len(bundles), 1)
+        nb = np.full(g, 2, np.int32)
+        missing = np.zeros(g, np.int32)
+        default_bin = np.zeros(g, np.int32)
+        is_cat = np.zeros(g, bool)
+        monotone = np.zeros(g, np.int8)
+        penalty = np.ones(g, np.float32)
+        missing_bin = np.full(g, -1, np.int32)
+        mc = list(config.monotone_constraints or [])
+        fc = list(config.feature_contri or [])
+        for gi, bd in enumerate(bundles):
+            if len(bd.members) == 1:
+                j = int(used[bd.members[0]])
+                m = self.mappers[j]
+                nb[gi] = m.num_bin
+                missing[gi] = m.missing_type
+                default_bin[gi] = m.default_bin
+                is_cat[gi] = m.bin_type == binning.BIN_TYPE_CATEGORICAL
+                if j < len(mc):
+                    monotone[gi] = np.int8(mc[j])
+                if j < len(fc):
+                    penalty[gi] = np.float32(fc[j])
+                mode_a = (m.num_bin > 2
+                          and m.missing_type != binning.MISSING_NONE)
+                if mode_a and m.missing_type == binning.MISSING_NAN:
+                    missing_bin[gi] = m.num_bin - 1
+                elif mode_a and m.missing_type == binning.MISSING_ZERO:
+                    missing_bin[gi] = m.default_bin
+            else:
+                nb[gi] = bd.num_bin
+        self.max_num_bins = int(nb.max()) if len(bundles) else 2
+        b = self.max_num_bins
+        seg_lo = np.zeros((g, b), np.int32)
+        seg_hi = np.zeros((g, b), np.int32)
+        is_bundle = np.zeros(g, bool)
+        fwd_ok = np.zeros((g, b), bool)
+        rev_ok = np.zeros((g, b), bool)
+        owner_orig = np.zeros((g, b), np.int32)
+        thr_fwd = np.tile(np.arange(b, dtype=np.int32), (g, 1))
+        thr_rev = np.tile(np.arange(b, dtype=np.int32), (g, 1))
+        for gi, bd in enumerate(bundles):
+            if len(bd.members) == 1:
+                seg_hi[gi, :] = nb[gi] - 1
+                owner_orig[gi, :] = int(used[bd.members[0]])
+                continue
+            is_bundle[gi] = True
+            # per-bin candidate masks reproducing each member's UNBUNDLED
+            # scan exactly: the member's most-frequent mass (reconstructed
+            # from leaf totals) sits at its ordinal position z, so forward
+            # candidates are thresholds below z (mass right) and reverse
+            # candidates thresholds at/above z (mass left); the leading
+            # phantom bin hosts the z-only-left candidate when z == 0
+            for mi, off in zip(bd.members, bd.offsets):
+                j = int(used[mi])
+                m = self.mappers[j]
+                nbm = m.num_bin
+                z = m.most_freq_bin
+                span = nbm                      # phantom + (nbm - 1) data
+                seg_lo[gi, off:off + span] = off
+                seg_hi[gi, off:off + span] = off + span - 1
+                owner_orig[gi, off:off + span] = j
+                r = np.arange(nbm - 1)          # data-bin ranks
+                dslice = slice(off + 1, off + span)
+                mode_zero = (m.missing_type == binning.MISSING_ZERO
+                             and nbm > 2)
+                if mode_zero:
+                    # zero-as-missing member: both directions, default-bin
+                    # threshold skipped (SKIP_DEFAULT_BIN semantics)
+                    t_orig = r + (r >= z)
+                    ok = t_orig <= nbm - 2
+                    fwd_ok[gi, dslice] = ok
+                    rev_ok[gi, dslice] = ok
+                    thr_fwd[gi, dslice] = t_orig
+                    thr_rev[gi, dslice] = t_orig
+                else:
+                    fwd_ok[gi, dslice] = r < z
+                    rev_ok[gi, dslice] = (r >= z - 1) & (r <= nbm - 3)
+                    thr_fwd[gi, dslice] = r
+                    thr_rev[gi, dslice] = r + 1
+                    if z == 0:                  # phantom: left = z mass only
+                        rev_ok[gi, off] = True
+                        thr_rev[gi, off] = 0
+        self._bundle_meta = BundleMeta(seg_lo=jnp.asarray(seg_lo),
+                                       seg_hi=jnp.asarray(seg_hi),
+                                       is_bundle=jnp.asarray(is_bundle),
+                                       fwd_ok=jnp.asarray(fwd_ok),
+                                       rev_ok=jnp.asarray(rev_ok))
+        self._owner_orig = owner_orig
+        self._thr_fwd = thr_fwd
+        self._thr_rev = thr_rev
+        self.has_categorical = bool(is_cat.any())
+        self._feature_meta = FeatureMeta(
+            num_bins=jnp.asarray(nb),
+            missing_type=jnp.asarray(missing),
+            default_bin=jnp.asarray(default_bin),
+            is_categorical=jnp.asarray(is_cat),
+            monotone=jnp.asarray(monotone),
+            penalty=jnp.asarray(penalty),
+        )
+        self._missing_bin = jnp.asarray(missing_bin)
+
+    def _bin_columns(self, X) -> np.ndarray:
+        """Raw matrix -> bundled bin matrix [N, G] (the analog of
+        FeatureGroup::PushData placement, feature_group.h)."""
+        sparse = _is_scipy_sparse(X)
+        if sparse:
+            X = X.tocsc()
+            n = X.shape[0]
+        else:
+            X = _to_2d_float(X)
+            n = X.shape[0]
+        used = self.used_features
+        g = len(self.bundles) if self.bundles else 0
+        out = np.zeros((n, max(g, 1)), dtype=np.int32)
+        for gi, bd in enumerate(self.bundles or []):
+            for mi, off in zip(bd.members, bd.offsets):
+                j = int(used[mi])
+                m = self.mappers[j]
+                if sparse:
+                    rows = X.indices[X.indptr[j]:X.indptr[j + 1]]
+                    vals = np.asarray(X.data[X.indptr[j]:X.indptr[j + 1]],
+                                      dtype=np.float64)
+                else:
+                    col = np.asarray(X[:, j], dtype=np.float64)
+                    rows = np.nonzero((col != 0.0) | np.isnan(col))[0]
+                    vals = col[rows]
+                if len(bd.members) == 1:
+                    out[:, gi] = m.default_bin
+                    if len(rows):
+                        out[rows, gi] = m.values_to_bins(vals)
+                else:
+                    bvals = m.values_to_bins(vals)
+                    sel = bvals != m.most_freq_bin
+                    bb = bvals[sel]
+                    bb = bb - (bb > m.most_freq_bin)
+                    # +1: data bins follow the member's phantom candidate bin
+                    out[np.asarray(rows)[sel], gi] = off + 1 + bb
+        return out
+
+    @property
+    def bundle_meta(self):
+        self.construct()
+        return getattr(self, "_bundle_meta", None) \
+            if self.bundles is not None else None
 
     def _build_feature_meta(self, config: Config):
         used = [self.mappers[j] for j in self.used_features]
@@ -300,12 +629,23 @@ class Dataset:
         return self._bins_T
 
     def num_used_features(self) -> int:
+        """Number of DEVICE COLUMNS (bundles count as one column each)."""
         self.construct()
+        if self.bundles is not None:
+            return max(len(self.bundles), 1)
         return max(len(self.used_features), 1)
 
     def bin_new_data(self, X) -> np.ndarray:
         """Bin raw features with this dataset's mappers (prediction path)."""
         self.construct()
+        if self.bundles is not None:
+            if not _is_scipy_sparse(X):
+                X = _to_2d_float(self._pandas_to_codes(X))
+            if X.shape[1] != self.num_total_features:
+                log.fatal(f"The number of features in data ({X.shape[1]}) is "
+                          f"not the same as it was in training data "
+                          f"({self.num_total_features}).")
+            return self._bin_columns(X)
         X = _to_2d_float(self._pandas_to_codes(X))
         if X.shape[1] != self.num_total_features:
             log.fatal(f"The number of features in data ({X.shape[1]}) is not the same"

@@ -184,19 +184,67 @@ def _find_bin_with_predefined(distinct_values: np.ndarray, counts: np.ndarray,
                               max_bin: int, total_sample_cnt: int,
                               min_data_in_bin: int,
                               forced_bounds: List[float]) -> List[float]:
-    """Forced bin bounds + greedy fill of the remainder
-    (reference: bin.cpp:157-254 FindBinWithPredefinedBin, simplified: forced
-    bounds become fixed boundaries, remaining budget binned greedily)."""
-    forced = sorted(set(forced_bounds))
-    bounds = [float(b) for b in forced if np.isfinite(b)]
-    remaining = max_bin - 1 - len(bounds)
-    if remaining > 0:
-        auto = find_bin_with_zero_as_one_bin(distinct_values, counts, remaining + 1,
-                                             total_sample_cnt, min_data_in_bin)
-        bounds.extend(b for b in auto if np.isfinite(b))
-    bounds = sorted(set(bounds))[:max_bin - 1]
-    bounds.append(math.inf)
-    return bounds
+    """Forced bin bounds + proportional greedy fill of each forced segment
+    (reference: bin.cpp:157-254 FindBinWithPredefinedBin: zero/inf bounds
+    first, forced bounds inserted up to the budget, then the free bins are
+    distributed across segments proportional to their sample counts and
+    found greedily within each)."""
+    nvals = len(distinct_values)
+    left_cnt = nvals
+    for i in range(nvals):
+        if distinct_values[i] > -K_ZERO_THRESHOLD:
+            left_cnt = i
+            break
+    right_start = -1
+    for i in range(left_cnt, nvals):
+        if distinct_values[i] > K_ZERO_THRESHOLD:
+            right_start = i
+            break
+
+    bin_upper_bound: List[float] = []
+    if max_bin == 2:
+        bin_upper_bound.append(K_ZERO_THRESHOLD if left_cnt == 0
+                               else -K_ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if left_cnt > 0:
+            bin_upper_bound.append(-K_ZERO_THRESHOLD)
+        if right_start >= 0:
+            bin_upper_bound.append(K_ZERO_THRESHOLD)
+    bin_upper_bound.append(math.inf)
+
+    max_to_insert = max_bin - len(bin_upper_bound)
+    num_inserted = 0
+    for b in forced_bounds:
+        if num_inserted >= max_to_insert:
+            break
+        if abs(b) > K_ZERO_THRESHOLD:
+            bin_upper_bound.append(float(b))
+            num_inserted += 1
+    bin_upper_bound.sort()
+
+    free_bins = max_bin - len(bin_upper_bound)
+    bounds_to_add: List[float] = []
+    value_ind = 0
+    for i, ub in enumerate(bin_upper_bound):
+        cnt_in_bin = 0
+        bin_start = value_ind
+        while value_ind < nvals and distinct_values[value_ind] < ub:
+            cnt_in_bin += int(counts[value_ind])
+            value_ind += 1
+        bins_remaining = (max_bin - len(bin_upper_bound)
+                          - len(bounds_to_add))
+        num_sub_bins = int(round(cnt_in_bin * free_bins
+                                 / max(total_sample_cnt, 1)))
+        num_sub_bins = min(num_sub_bins, bins_remaining) + 1
+        if i == len(bin_upper_bound) - 1:
+            num_sub_bins = bins_remaining + 1
+        new_ub = greedy_find_bin(distinct_values[bin_start:value_ind],
+                                 counts[bin_start:value_ind], num_sub_bins,
+                                 cnt_in_bin, min_data_in_bin)
+        bounds_to_add.extend(new_ub[:-1])       # last bound is infinity
+    out = sorted(bin_upper_bound + bounds_to_add)
+    assert len(out) <= max_bin
+    return out
 
 
 class BinMapper:
@@ -429,6 +477,34 @@ def sample_indices(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
     return np.sort(rng.choice(num_data, size=sample_cnt, replace=False))
 
 
+def fit_mapper_for_column(j: int, vals: np.ndarray, total_sample_cnt: int,
+                          config, cat_set, filter_cnt: int,
+                          forced_bounds=None) -> BinMapper:
+    """Fit one column's BinMapper with the config's binning parameters —
+    the single point both the dense and the sparse/EFB construct paths go
+    through (reference: DatasetLoader::ConstructBinMappersFromTextData's
+    per-feature FindBin call, dataset_loader.cpp:953-1140)."""
+    m = BinMapper()
+    max_bin = (config.max_bin_by_feature[j]
+               if j < len(config.max_bin_by_feature) else config.max_bin)
+    m.find_bin(
+        vals, total_sample_cnt=total_sample_cnt, max_bin=max_bin,
+        min_data_in_bin=config.min_data_in_bin,
+        min_split_data=filter_cnt,
+        pre_filter=config.feature_pre_filter,
+        bin_type=BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL,
+        use_missing=config.use_missing,
+        zero_as_missing=config.zero_as_missing,
+        forced_bounds=(forced_bounds or {}).get(j),
+    )
+    return m
+
+
+def filter_cnt_for_sample(config, sample_cnt: int, num_data: int) -> int:
+    """reference: dataset_loader.cpp:647-648 filter_cnt scaling."""
+    return int(config.min_data_in_leaf * sample_cnt / max(num_data, 1))
+
+
 def find_bin_mappers(X: np.ndarray, config, categorical_features: Sequence[int] = (),
                      forced_bounds: Optional[Dict[int, List[float]]] = None) -> List[BinMapper]:
     """Fit one BinMapper per column (reference: DatasetLoader::
@@ -437,28 +513,13 @@ def find_bin_mappers(X: np.ndarray, config, categorical_features: Sequence[int] 
     sample_idx = sample_indices(num_data, config.bin_construct_sample_cnt,
                                 config.data_random_seed)
     cat_set = set(int(c) for c in categorical_features)
-    forced_bounds = forced_bounds or {}
-    mappers = []
-    max_bin_by_feature = config.max_bin_by_feature
-    # reference: dataset_loader.cpp:647-648 filter_cnt scaling
-    filter_cnt = int(config.min_data_in_leaf * len(sample_idx) / max(num_data, 1))
-    for j in range(num_features):
-        col = np.asarray(X[sample_idx, j], dtype=np.float64)
-        m = BinMapper()
-        max_bin = (max_bin_by_feature[j] if j < len(max_bin_by_feature)
-                   else config.max_bin)
-        m.find_bin(
-            col, total_sample_cnt=len(sample_idx), max_bin=max_bin,
-            min_data_in_bin=config.min_data_in_bin,
-            min_split_data=filter_cnt,
-            pre_filter=config.feature_pre_filter,
-            bin_type=BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL,
-            use_missing=config.use_missing,
-            zero_as_missing=config.zero_as_missing,
-            forced_bounds=forced_bounds.get(j),
-        )
-        mappers.append(m)
-    return mappers
+    filter_cnt = filter_cnt_for_sample(config, len(sample_idx), num_data)
+    return [
+        fit_mapper_for_column(
+            j, np.asarray(X[sample_idx, j], dtype=np.float64),
+            len(sample_idx), config, cat_set, filter_cnt, forced_bounds)
+        for j in range(num_features)
+    ]
 
 
 def bin_data(X: np.ndarray, mappers: Sequence[BinMapper]) -> np.ndarray:

@@ -101,6 +101,9 @@ class Booster:
     def predict(self, data, start_iteration: int = 0,
                 num_iteration: Optional[int] = None, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0,
                 **kwargs) -> np.ndarray:
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
@@ -110,7 +113,10 @@ class Booster:
             return self._boosting.predict_contrib(data, num_iteration)
         return self._boosting.predict(data, raw_score=raw_score,
                                       num_iteration=num_iteration,
-                                      start_iteration=start_iteration)
+                                      start_iteration=start_iteration,
+                                      pred_early_stop=pred_early_stop,
+                                      pred_early_stop_freq=pred_early_stop_freq,
+                                      pred_early_stop_margin=pred_early_stop_margin)
 
     # ------------------------------------------------------------ model IO
     def save_model(self, filename: str, num_iteration: Optional[int] = None,

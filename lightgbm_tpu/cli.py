@@ -59,8 +59,12 @@ def _read_header(path: str, config: Config) -> Optional[List[str]]:
         return None
     with open(path) as fh:
         first = fh.readline().rstrip("\n")
-    delim = "," if "," in first else "\t"
-    return first.split(delim)
+    if "," in first:
+        return first.split(",")
+    if "\t" in first:
+        return first.split("\t")
+    # whitespace-separated files (the native parser's auto-detected format)
+    return first.split()
 
 
 def _side_file(path: str, suffix: str) -> Optional[np.ndarray]:

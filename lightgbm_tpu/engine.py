@@ -66,6 +66,24 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
             if train_set.data is None:
                 log.fatal("Cannot use init_model with a Dataset whose raw "
                           "data was freed")
+            # pandas category columns must map through the SAME category ->
+            # code lists as the init model, or the loaded trees' thresholds
+            # silently misalign with the new Dataset's codes (reference:
+            # basic.py train/predict pandas_categorical contract)
+            pc = {int(k): list(v)
+                  for k, v in (loaded.meta.get("pandas_categorical")
+                               or {}).items()}
+            if pc:
+                if train_set._constructed:
+                    if {int(k): list(v)
+                            for k, v in train_set.pandas_categorical.items()} \
+                            != pc:
+                        log.fatal(
+                            "train and init_model pandas categorical columns "
+                            "do not match: construct the training Dataset "
+                            "from data with the same category lists")
+                else:
+                    train_set.pandas_categorical = pc
             train_set.init_score = loaded.predict_raw(train_set.data)
             for vs in (valid_sets or []):
                 if vs is train_set:

@@ -604,7 +604,10 @@ class LoadedGBDT:
 
     # ----------------------------------------------------------- predict
     def predict_raw(self, X, num_iteration: Optional[int] = None,
-                    start_iteration: int = 0) -> np.ndarray:
+                    start_iteration: int = 0,
+                    pred_early_stop: bool = False,
+                    pred_early_stop_freq: int = 10,
+                    pred_early_stop_margin: float = 10.0) -> np.ndarray:
         X = self._check_features(X)
         k = self.num_tree_per_iteration
         total = self.num_iteration
@@ -613,17 +616,25 @@ class LoadedGBDT:
         else:
             end = min(start_iteration + num_iteration, total)
         out = np.zeros((X.shape[0], k), np.float64)
+        active = np.ones(X.shape[0], dtype=bool)
+        use_es = pred_early_stop and not self.average_output
+        from ..models.gbdt import _accumulate_active, _early_stop_mask
         for it in range(start_iteration, end):
             for c in range(k):
-                out[:, c] += self.trees[it * k + c].predict(X)
+                delta = self.trees[it * k + c].predict(X)
+                _accumulate_active(out, c, delta, active, use_es)
+            if use_es and (it - start_iteration + 1) % pred_early_stop_freq == 0:
+                active &= ~_early_stop_mask(out, k, pred_early_stop_margin)
+                if not active.any():
+                    break
         if self.average_output:
             out /= max(end - start_iteration, 1)
         return out if k > 1 else out[:, 0]
 
     def predict(self, X, raw_score: bool = False,
                 num_iteration: Optional[int] = None,
-                start_iteration: int = 0) -> np.ndarray:
-        raw = self.predict_raw(X, num_iteration, start_iteration)
+                start_iteration: int = 0, **kwargs) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, start_iteration, **kwargs)
         if raw_score or self.objective is None:
             return raw
         import jax.numpy as jnp

@@ -90,59 +90,78 @@ def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
     return total
 
 
-def _tree_shap_recurse(tree, x: np.ndarray, phi: np.ndarray, node: int,
-                       unique_depth: int, parent_path: List[_PathElement],
-                       parent_zero_fraction: float,
-                       parent_one_fraction: float,
-                       parent_feature_index: int) -> None:
-    path = [p.copy() for p in parent_path[:unique_depth]]
-    path.extend(_PathElement() for _ in range(2))
-    _extend_path(path, unique_depth, parent_zero_fraction,
-                 parent_one_fraction, parent_feature_index)
+def tree_shap_values_batch(tree, X: np.ndarray,
+                           num_features: int) -> np.ndarray:
+    """TreeSHAP contributions of one tree for ALL rows: [N, num_features+1]
+    (last column = expected value).
 
-    if node < 0:   # leaf
-        li = ~node
-        leaf_value = float(tree.leaf_value[li])
-        for i in range(1, unique_depth + 1):
-            w = _unwound_path_sum(path, unique_depth, i)
-            el = path[i]
-            phi[el.feature_index] += (w * (el.one_fraction - el.zero_fraction)
-                                      * leaf_value)
-        return
+    Iterative (explicit stack, no Python recursion — a 255-leaf leaf-wise
+    chain would otherwise flirt with the recursion limit) with the per-node
+    routing decisions precomputed VECTORIZED across rows, so the per-row
+    walk does no numpy work beyond float accumulation."""
+    n = X.shape[0]
+    out = np.zeros((n, num_features + 1), np.float64)
+    out[:, -1] = tree_expected_value(tree)
+    if tree.num_leaves <= 1 or n == 0:
+        return out
+    n_nodes = tree.num_leaves - 1
+    # row-batched decisions: one vectorized _go_left per node
+    dec = np.zeros((n_nodes, n), bool)
+    nodes_arr = np.empty(n, dtype=np.int64)
+    for node in range(n_nodes):
+        nodes_arr.fill(node)
+        dec[node] = tree._go_left(nodes_arr,
+                                  X[:, int(tree.split_feature[node])])
+    sf = [int(s) for s in tree.split_feature]
+    lc = [int(c) for c in tree.left_child]
+    rc = [int(c) for c in tree.right_child]
+    icount = [float(c) for c in tree.internal_count]
+    lcount = [float(c) for c in tree.leaf_count]
+    lvalue = [float(v) for v in tree.leaf_value]
 
-    feat = int(tree.split_feature[node])
-    left, right = int(tree.left_child[node]), int(tree.right_child[node])
-    go_left = bool(tree._go_left(np.array([node]), np.array([x[feat]]))[0])
-    hot, cold = (left, right) if go_left else (right, left)
+    for r in range(n):
+        phi = out[r]
+        stack = [(0, 0, [], 1.0, 1.0, -1)]
+        while stack:
+            node, ud, parent_path, pzf, pof, pfi = stack.pop()
+            path = [p.copy() for p in parent_path[:ud]]
+            path.extend(_PathElement() for _ in range(2))
+            _extend_path(path, ud, pzf, pof, pfi)
 
-    node_count = float(tree.internal_count[node])
+            if node < 0:   # leaf
+                lv = lvalue[~node]
+                for i in range(1, ud + 1):
+                    w = _unwound_path_sum(path, ud, i)
+                    el = path[i]
+                    phi[el.feature_index] += (
+                        w * (el.one_fraction - el.zero_fraction) * lv)
+                continue
 
-    def child_count(c):
-        return float(tree.leaf_count[~c] if c < 0 else tree.internal_count[c])
+            feat = sf[node]
+            left, right = lc[node], rc[node]
+            hot, cold = (left, right) if dec[node, r] else (right, left)
+            node_count = icount[node]
 
-    hot_zero_fraction = child_count(hot) / node_count if node_count > 0 else 0.0
-    cold_zero_fraction = child_count(cold) / node_count if node_count > 0 else 0.0
-    incoming_zero_fraction = 1.0
-    incoming_one_fraction = 1.0
+            def child_count(c):
+                return lcount[~c] if c < 0 else icount[c]
 
-    # if this feature was seen before on the path, undo that split
-    path_index = 0
-    while path_index <= unique_depth:
-        if path[path_index].feature_index == feat:
-            break
-        path_index += 1
-    if path_index != unique_depth + 1:
-        incoming_zero_fraction = path[path_index].zero_fraction
-        incoming_one_fraction = path[path_index].one_fraction
-        _unwind_path(path, unique_depth, path_index)
-        unique_depth -= 1
+            hot_zero = child_count(hot) / node_count if node_count > 0 else 0.0
+            cold_zero = child_count(cold) / node_count if node_count > 0 else 0.0
+            izf = iof = 1.0
 
-    _tree_shap_recurse(tree, x, phi, hot, unique_depth + 1, path,
-                       hot_zero_fraction * incoming_zero_fraction,
-                       incoming_one_fraction, feat)
-    _tree_shap_recurse(tree, x, phi, cold, unique_depth + 1, path,
-                       cold_zero_fraction * incoming_zero_fraction,
-                       0.0, feat)
+            # if this feature was seen before on the path, undo that split
+            pi = 0
+            while pi <= ud and path[pi].feature_index != feat:
+                pi += 1
+            if pi != ud + 1:
+                izf = path[pi].zero_fraction
+                iof = path[pi].one_fraction
+                _unwind_path(path, ud, pi)
+                ud -= 1
+
+            stack.append((hot, ud + 1, path, hot_zero * izf, iof, feat))
+            stack.append((cold, ud + 1, path, cold_zero * izf, 0.0, feat))
+    return out
 
 
 def tree_expected_value(tree) -> float:
@@ -160,11 +179,7 @@ def tree_expected_value(tree) -> float:
 def tree_shap_values(tree, x: np.ndarray, num_features: int) -> np.ndarray:
     """SHAP contributions of one tree for one row: [num_features + 1]
     (last = expected value)."""
-    phi = np.zeros(num_features + 1, np.float64)
-    phi[-1] = tree_expected_value(tree)
-    if tree.num_leaves > 1:
-        _tree_shap_recurse(tree, x, phi, 0, 0, [], 1.0, 1.0, -1)
-    return phi
+    return tree_shap_values_batch(tree, x.reshape(1, -1), num_features)[0]
 
 
 def predict_contrib_trees(trees, X: np.ndarray, num_features: int,
@@ -178,11 +193,15 @@ def predict_contrib_trees(trees, X: np.ndarray, num_features: int,
     k = max(num_tree_per_iteration, 1)
     width = num_features + 1
     out = np.zeros((n, width * k), np.float64)
-    for ti, tree in enumerate(trees):
-        c = ti % k
-        for r in range(n):
-            out[r, c * width:(c + 1) * width] += tree_shap_values(
-                tree, X[r], num_features)
+    # row chunks bound the per-tree [n_nodes, rows] decision matrix
+    # (255-leaf trees at 10M rows would otherwise allocate ~2.5 GB per tree)
+    chunk = 65536
+    for r0 in range(0, n, chunk):
+        Xc = X[r0:r0 + chunk]
+        for ti, tree in enumerate(trees):
+            c = ti % k
+            out[r0:r0 + chunk, c * width:(c + 1) * width] += \
+                tree_shap_values_batch(tree, Xc, num_features)
     if average and trees:
         out /= (len(trees) // k)
     return out

@@ -182,7 +182,69 @@ class GBDT:
                 self._cegb_lazy = jnp.asarray(arr)
         self._use_bynode = cfg.feature_fraction_bynode < 1.0
         self._extra_rng_key = jax.random.PRNGKey(cfg.extra_seed)
+        # gpu_use_dp analog: float64 histogram accumulation (the reference
+        # CPU's hist_t precision; bin.h:32) — requires jax x64
+        self._hist_dp = bool(cfg.gpu_use_dp)
+        if self._hist_dp and not jax.config.jax_enable_x64:
+            log.warning("gpu_use_dp=true needs jax x64 (set JAX_ENABLE_X64=1 "
+                        "or jax.config.update('jax_enable_x64', True)); "
+                        "falling back to float32 histograms")
+            self._hist_dp = False
+        self._forced_splits = self._load_forced_splits(train_set)
         self._setup_tree_learner()
+
+    def _load_forced_splits(self, ts: Dataset):
+        """Parse forcedsplits_filename JSON into flat preorder arrays for the
+        grower's forced phase (reference: serial_tree_learner.cpp:450
+        ForceSplits; format {"feature": i, "threshold": v, "left": {...},
+        "right": {...}})."""
+        fn = self.config.forcedsplits_filename
+        if not fn:
+            return None
+        import json
+        from .. import binning
+        try:
+            with open(fn) as fh:
+                data = json.load(fh)
+        except OSError:
+            log.warning(f"Could not open forced splits file {fn}. "
+                        f"Will ignore.")
+            return None
+        if not data:
+            return None
+        ts.construct()
+        if ts.bundles is not None:
+            col_of = {}
+            for gi, bd in enumerate(ts.bundles):
+                if len(bd.members) == 1:
+                    col_of[int(ts.used_features[bd.members[0]])] = gi
+        else:
+            col_of = {int(j): i for i, j in enumerate(ts.used_features)}
+        nodes: List[List[int]] = []
+
+        def rec(node) -> int:
+            orig = int(node["feature"])
+            col = col_of.get(orig)
+            m = ts.mappers[orig] if orig < len(ts.mappers) else None
+            if (col is None or m is None
+                    or m.bin_type != binning.BIN_TYPE_NUMERICAL):
+                log.warning(f"forced split on feature {orig} ignored "
+                            f"(unused, bundled or categorical)")
+                return -1
+            idx = len(nodes)
+            nodes.append([col, m.value_to_bin(float(node["threshold"])),
+                          -1, -1])
+            if node.get("left"):
+                nodes[idx][2] = rec(node["left"])
+            if node.get("right"):
+                nodes[idx][3] = rec(node["right"])
+            return idx
+
+        if rec(data) != 0 or not nodes:
+            return None
+        arr = np.asarray(nodes, np.int32)
+        return (jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1]),
+                jnp.asarray(arr[:, 2]), jnp.asarray(arr[:, 3]))
 
     def _setup_tree_learner(self) -> None:
         """tree_learner dispatch (reference: TreeLearner factory,
@@ -209,6 +271,10 @@ class GBDT:
             unsupported.append("linear_tree")
         if mode == "voting" and self.train_set.has_categorical:
             unsupported.append("categorical features (voting)")
+        if self.train_set.bundle_meta is not None:
+            unsupported.append("EFB-bundled datasets")
+        if getattr(self, "_forced_splits", None) is not None:
+            unsupported.append("forced splits")
         if unsupported:
             log.fatal(f"tree_learner={mode} does not support: "
                       f"{', '.join(unsupported)}")
@@ -287,14 +353,16 @@ class GBDT:
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (gbdt.cpp:369-452). Returns True when the
         iteration could not add any tree with a split (early stoppable)."""
+        from ..utils import profiling
         cfg = self.config
         ts = self.train_set
         k = self.num_tree_per_iteration
-        if grad is None:
-            g, h = self._gradients()
-        else:
-            g = jnp.asarray(np.asarray(grad, dtype=np.float32).reshape(self._score_shape))
-            h = jnp.asarray(np.asarray(hess, dtype=np.float32).reshape(self._score_shape))
+        with profiling.timer("gradients"):
+            if grad is None:
+                g, h = self._gradients()
+            else:
+                g = jnp.asarray(np.asarray(grad, dtype=np.float32).reshape(self._score_shape))
+                h = jnp.asarray(np.asarray(hess, dtype=np.float32).reshape(self._score_shape))
         self._update_bagging()
         mask = self._bag_mask
         sample_weights = self._sample_weights(g, h)
@@ -314,39 +382,10 @@ class GBDT:
             fmask = self._feature_mask()
             iter_key = jax.random.fold_in(self._extra_rng_key,
                                           self.iter * k + c)
-            if self._parallel_grower is not None:
-                tree, leaf_id, aux = self._parallel_grower(
-                    ts.bins, gc, hc, mask,
-                    ts.feature_meta, self.split_params, fmask, ts.missing_bin,
-                    binsT=ts.bins_T if hm == "onehot" else None,
-                    rng_key=iter_key,
-                    max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
-                    max_depth=cfg.max_depth, hist_method=hm,
-                    exact=cfg.tree_growth_mode == "exact",
-                    with_categorical=ts.has_categorical,
-                    with_monotone=self._with_monotone,
-                    vote_top_k=cfg.top_k)
-            else:
-                tree, leaf_id, aux = grow_tree(
-                    ts.bins, gc, hc, mask,
-                    ts.feature_meta, self.split_params, fmask, ts.missing_bin,
-                    max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
-                    max_depth=cfg.max_depth, hist_method=hm,
-                    binsT=ts.bins_T if hm == "onehot" else None,
-                    exact=cfg.tree_growth_mode == "exact",
-                    with_categorical=ts.has_categorical,
-                    with_monotone=self._with_monotone,
-                    with_interactions=self._with_interactions,
-                    interaction_groups=self._interaction_groups,
-                    cegb_mode=self._cegb_mode,
-                    cegb_coupled=self._cegb_coupled,
-                    cegb_lazy_penalty=self._cegb_lazy,
-                    cegb_state=self._cegb_aux,
-                    extra_trees=cfg.extra_trees,
-                    use_bynode=self._use_bynode,
-                    bynode_fraction=jnp.float32(cfg.feature_fraction_bynode)
-                    if self._use_bynode else None,
-                    rng_key=iter_key)
+            with profiling.timer_sync("grow_tree") as grow_scope:
+                tree, leaf_id, aux = self._grow_one(gc, hc, mask, fmask,
+                                                    iter_key, hm)
+                grow_scope.sync(tree.num_leaves)
             if self._cegb_mode != "off":
                 # CEGB feature-used tracking persists across iterations
                 # (cost_effective_gradient_boosting.hpp Init: !init_ reuse)
@@ -358,15 +397,60 @@ class GBDT:
                 first_tree = len(self.trees) < k and self.loaded_iters == 0
                 lin = self._fit_linear_leaves(tree, leaf_id, gc, hc, mask,
                                               first_tree)
-            tree, t_host, had_split = self._finalize_tree(tree, leaf_id, c)
+            with profiling.timer("finalize_tree"):
+                tree, t_host, had_split = self._finalize_tree(tree, leaf_id, c)
             no_split = no_split and not had_split
-            if lin is not None:
-                self._add_tree(tree, leaf_id, c, linear=lin, t_host=t_host)
-            else:
-                self._add_tree(tree, leaf_id, c, t_host=t_host)
-            self._bias_after_score(c, had_split)
+            with profiling.timer("score_update", sync=None):
+                if lin is not None:
+                    self._add_tree(tree, leaf_id, c, linear=lin, t_host=t_host)
+                else:
+                    self._add_tree(tree, leaf_id, c, t_host=t_host)
+                self._bias_after_score(c, had_split)
         self.iter += 1
         return no_split
+
+    def _grow_one(self, gc: jax.Array, hc: jax.Array, mask: jax.Array,
+                  fmask: jax.Array, iter_key: jax.Array, hm: str):
+        """Dispatch one tree's growth to the serial grower or the configured
+        parallel learner (the analog of TreeLearner::Train through the
+        factory-selected learner, tree_learner.h:104)."""
+        cfg = self.config
+        ts = self.train_set
+        if self._parallel_grower is not None:
+            return self._parallel_grower(
+                ts.bins, gc, hc, mask,
+                ts.feature_meta, self.split_params, fmask, ts.missing_bin,
+                binsT=ts.bins_T if hm == "onehot" else None,
+                rng_key=iter_key,
+                max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
+                max_depth=cfg.max_depth, hist_method=hm,
+                exact=cfg.tree_growth_mode == "exact",
+                with_categorical=ts.has_categorical,
+                with_monotone=self._with_monotone,
+                vote_top_k=cfg.top_k, hist_dp=self._hist_dp)
+        return grow_tree(
+            ts.bins, gc, hc, mask,
+            ts.feature_meta, self.split_params, fmask, ts.missing_bin,
+            max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
+            max_depth=cfg.max_depth, hist_method=hm,
+            binsT=ts.bins_T if hm == "onehot" else None,
+            exact=cfg.tree_growth_mode == "exact",
+            with_categorical=ts.has_categorical,
+            with_monotone=self._with_monotone,
+            with_interactions=self._with_interactions,
+            interaction_groups=self._interaction_groups,
+            cegb_mode=self._cegb_mode,
+            cegb_coupled=self._cegb_coupled,
+            cegb_lazy_penalty=self._cegb_lazy,
+            cegb_state=self._cegb_aux,
+            extra_trees=cfg.extra_trees,
+            use_bynode=self._use_bynode,
+            bynode_fraction=jnp.float32(cfg.feature_fraction_bynode)
+            if self._use_bynode else None,
+            rng_key=iter_key,
+            bundle_meta=ts.bundle_meta,
+            forced_splits=self._forced_splits,
+            hist_dp=self._hist_dp)
 
     def _hist_method(self) -> str:
         from ..ops.histogram import resolve_method
@@ -575,6 +659,33 @@ class GBDT:
         bins_thr = np.asarray(tree.node_threshold_bin[:n_nodes])
         real_thr = np.zeros(n_nodes, dtype=np.float64)
         missing = np.zeros(n_nodes, dtype=np.int8)
+        if ds.bundles is not None:
+            # bundle columns: map (column, bundle bin) back to the owning
+            # ORIGINAL feature + its bin; the host/model tree is bundle-free
+            # (saved models reference original features, like the reference's)
+            seg_lo = np.asarray(tree.node_seg_lo[:n_nodes])
+            dleft = np.asarray(tree.node_default_left[:n_nodes])
+            orig_feats = np.zeros(n_nodes, dtype=np.int32)
+            for i in range(n_nodes):
+                g, t = int(feats[i]), int(bins_thr[i])
+                orig = int(ds._owner_orig[g, t])
+                orig_feats[i] = orig
+                mapper = ds.mappers[orig]
+                missing[i] = mapper.missing_type
+                if seg_lo[i] >= 0:      # bundle split: map back to the
+                    # member's own bin space (direction-dependent)
+                    thr_tab = ds._thr_rev if dleft[i] else ds._thr_fwd
+                    real_thr[i] = mapper.bin_to_value(int(thr_tab[g, t]))
+                else:
+                    real_thr[i] = mapper.bin_to_value(t)
+            full_thr = np.zeros(tree.node_threshold_bin.shape[0],
+                                dtype=np.float64)
+            full_thr[:n_nodes] = real_thr
+            ht = HostTree(tree, full_thr,
+                          np.arange(ds.num_total_features, dtype=np.int32),
+                          missing)
+            ht.split_feature = orig_feats
+            return ht
         used = ds.used_features
         for i in range(n_nodes):
             mapper = ds.mappers[used[feats[i]]]
@@ -656,8 +767,12 @@ class GBDT:
     def _prep_predict_X(self, X) -> np.ndarray:
         """Predict-time feature matrix: pandas category columns are mapped
         through the train-time category lists BEFORE any array conversion
-        (np.asarray on a category dtype would yield raw values, not codes)."""
-        from ..basic import _to_2d_float
+        (np.asarray on a category dtype would yield raw values, not codes).
+        scipy sparse inputs pass through unchanged (binned column-wise
+        without densifying)."""
+        from ..basic import _is_scipy_sparse, _to_2d_float
+        if _is_scipy_sparse(X):
+            return X
         X = self.train_set._pandas_to_codes(X)
         X = _to_2d_float(X)
         if X.ndim == 1:
@@ -678,16 +793,27 @@ class GBDT:
         return stacked
 
     def predict_raw(self, X, num_iteration: Optional[int] = None,
-                    start_iteration: int = 0) -> np.ndarray:
+                    start_iteration: int = 0,
+                    pred_early_stop: bool = False,
+                    pred_early_stop_freq: int = 10,
+                    pred_early_stop_margin: float = 10.0) -> np.ndarray:
         """Raw scores for new raw-feature data (binned via the train mappers;
         the analog of GBDT::PredictRaw, gbdt_prediction.cpp:13-53). The
         boost-from-average init score lives inside the first tree's leaves
         (see _bias_after_score), so prediction is a pure sum of tree outputs.
         Iterations from a loaded init model come first (gbdt.h
-        num_init_iteration_)."""
+        num_init_iteration_). ``pred_early_stop``: margin-based per-row
+        early exit — rows whose margin exceeds the threshold at a check
+        round stop accumulating further trees (reference:
+        prediction_early_stop.cpp:25-75, hook in gbdt_prediction.cpp)."""
         X = self._prep_predict_X(X)
-        if self.config.linear_tree:
-            # linear leaves predict on raw features via the model-space trees
+        if self.config.linear_tree or self.train_set.bundles is not None:
+            # raw-feature prediction via the model-space trees: linear leaves
+            # need raw features, and EFB-bundled datasets must not bin new
+            # data through shared bundle columns (new rows may violate the
+            # exclusivity the training rows satisfied — the reference also
+            # predicts on raw features with real thresholds, predictor.hpp)
+            from ..basic import _is_scipy_sparse
             from ..io.model_text import ModelTree
             k = self.num_tree_per_iteration
             total_iters = self.loaded_iters + len(self.trees) // k
@@ -695,11 +821,14 @@ class GBDT:
                 end_iter = total_iters
             else:
                 end_iter = min(start_iteration + num_iteration, total_iters)
+            if _is_scipy_sparse(X):
+                X = np.asarray(X.todense())
             out = np.zeros((X.shape[0], k), dtype=np.float64)
+            active = np.ones(X.shape[0], dtype=bool)
             for it in range(start_iteration, end_iter):
                 for c in range(k):
                     if it < self.loaded_iters:
-                        out[:, c] += self.loaded.trees[it * k + c].predict(X)
+                        delta = self.loaded.trees[it * k + c].predict(X)
                     else:
                         idx = (it - self.loaded_iters) * k + c
                         mt = self._mt_cache.get(idx)
@@ -707,7 +836,14 @@ class GBDT:
                             mt = ModelTree.from_host(self.host_trees[idx],
                                                      self.train_set.mappers)
                             self._mt_cache[idx] = mt
-                        out[:, c] += mt.predict(X)
+                        delta = mt.predict(X)
+                    _accumulate_active(out, c, delta, active, pred_early_stop)
+                if pred_early_stop and \
+                        (it - start_iteration + 1) % pred_early_stop_freq == 0:
+                    active &= ~_early_stop_mask(out, k,
+                                                pred_early_stop_margin)
+                    if not active.any():
+                        break
             return out if k > 1 else out[:, 0]
         bins = jnp.asarray(self.train_set.bin_new_data(X))
         k = self.num_tree_per_iteration
@@ -721,19 +857,32 @@ class GBDT:
             end_iter = min(start_iteration + num_iteration, total_iters)
         out = np.zeros((n, k), dtype=np.float64)
         mb = self.train_set.missing_bin
+        active = np.ones(n, dtype=bool)
         for it in range(start_iteration, end_iter):
             for c in range(k):
                 if it < self.loaded_iters:
-                    out[:, c] += self.loaded.trees[it * k + c].predict(X)
+                    delta = self.loaded.trees[it * k + c].predict(X)
                 else:
                     tree = self.trees[(it - self.loaded_iters) * k + c]
-                    out[:, c] += np.asarray(predict_value_bins(tree, bins, mb))
+                    delta = np.asarray(predict_value_bins(tree, bins, mb))
+                _accumulate_active(out, c, delta, active, pred_early_stop)
+            if pred_early_stop and \
+                    (it - start_iteration + 1) % pred_early_stop_freq == 0:
+                active &= ~_early_stop_mask(out, k, pred_early_stop_margin)
+                if not active.any():
+                    break
         return out if k > 1 else out[:, 0]
 
     def predict(self, X, raw_score: bool = False,
                 num_iteration: Optional[int] = None,
-                start_iteration: int = 0) -> np.ndarray:
-        raw = self.predict_raw(X, num_iteration, start_iteration)
+                start_iteration: int = 0,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, start_iteration,
+                               pred_early_stop=pred_early_stop,
+                               pred_early_stop_freq=pred_early_stop_freq,
+                               pred_early_stop_margin=pred_early_stop_margin)
         if raw_score or self.objective is None:
             return raw
         conv = np.asarray(self.objective.convert_output(jnp.asarray(raw)))
@@ -743,7 +892,10 @@ class GBDT:
                      start_iteration: int = 0) -> np.ndarray:
         """Per-tree leaf indices (reference: predict_leaf_index path)."""
         X = self._prep_predict_X(X)
-        bins = jnp.asarray(self.train_set.bin_new_data(X))
+        bundled = self.train_set.bundles is not None
+        # bundled datasets traverse raw features via ModelTree (see
+        # predict_raw) — don't bin the prediction matrix at all
+        bins = None if bundled else jnp.asarray(self.train_set.bin_new_data(X))
         k = self.num_tree_per_iteration
         total_iters = self.loaded_iters + len(self.trees) // k
         if num_iteration is None or num_iteration <= 0:
@@ -751,15 +903,29 @@ class GBDT:
         else:
             end_iter = min(start_iteration + num_iteration, total_iters)
         mb = self.train_set.missing_bin
+        if bundled:
+            from ..basic import _is_scipy_sparse
+            from ..io.model_text import ModelTree
+            if _is_scipy_sparse(X):
+                X = np.asarray(X.todense())
         cols = []
         for it in range(start_iteration, end_iter):
             for c in range(k):
                 if it < self.loaded_iters:
                     cols.append(self.loaded.trees[it * k + c].leaf_index(X))
+                elif bundled:
+                    idx = (it - self.loaded_iters) * k + c
+                    mt = self._mt_cache.get(idx)
+                    if mt is None:
+                        mt = ModelTree.from_host(self.host_trees[idx],
+                                                 self.train_set.mappers)
+                        self._mt_cache[idx] = mt
+                    cols.append(mt.leaf_index(X))
                 else:
                     tree = self.trees[(it - self.loaded_iters) * k + c]
                     cols.append(np.asarray(predict_leaf_bins(tree, bins, mb)))
-        return np.stack(cols, axis=1) if cols else np.zeros((bins.shape[0], 0), np.int32)
+        return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0),
+                                                            np.int32)
 
     def predict_contrib(self, X, num_iteration: Optional[int] = None,
                         start_iteration: int = 0) -> np.ndarray:
@@ -808,6 +974,30 @@ class GBDT:
 
     def current_iteration(self) -> int:
         return self.iter + self.loaded_iters
+
+
+def _accumulate_active(out: np.ndarray, c: int, delta: np.ndarray,
+                       active: np.ndarray, early_stop: bool) -> None:
+    """Add a tree's outputs to the active rows; plain add on the hot path
+    when prediction early stop is off (boolean fancy-indexing costs two
+    full-size copies per tree)."""
+    if not early_stop or active.all():
+        out[:, c] += delta
+    else:
+        out[active, c] += delta[active]
+
+
+def _early_stop_mask(out: np.ndarray, k: int,
+                     margin_threshold: float) -> np.ndarray:
+    """Rows whose prediction margin already exceeds the early-stop threshold
+    (reference: prediction_early_stop.cpp — binary margin = 2|pred| (:58-66),
+    multiclass margin = top1 - top2 (:29-49))."""
+    if k == 1:
+        margin = 2.0 * np.abs(out[:, 0])
+    else:
+        srt = np.sort(out, axis=1)
+        margin = srt[:, -1] - srt[:, -2]
+    return margin > margin_threshold
 
 
 def _call_feval(feval, score_np, ds, objective, ds_name="valid"):

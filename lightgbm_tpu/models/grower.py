@@ -93,6 +93,8 @@ class GrowState(NamedTuple):
                              # parent histogram lives at slot min(l, sib[l])
     parent_hist: jax.Array   # [L] bool: slot's hist holds the PARENT's data
     done: jax.Array          # bool: a split phase found nothing to split
+    forced_idx: jax.Array    # int32: next forced-split node to apply
+    forced_slot: jax.Array   # [K] int32 leaf slot per forced node (-1 = dead)
     best: SplitInfo
     tree: TreeArrays
     num_leaves: jax.Array    # int32
@@ -127,6 +129,13 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
         col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
     mb = missing_bin[feat]
     num_left = jnp.where((col == mb) & (mb >= 0), dleft, col <= thr)
+    # EFB bundle split: rows outside the owning member's segment are its
+    # default mass and route by the default direction (bundling.py layout)
+    seg_lo = best.seg_lo[l]
+    seg_hi = best.seg_hi[l]
+    in_seg = (col >= seg_lo) & (col <= seg_hi)
+    num_left = jnp.where(seg_lo >= 0,
+                         jnp.where(in_seg, col <= thr, dleft), num_left)
     # categorical: bitset membership (Tree::CategoricalDecision, tree.h:349)
     word = jnp.take(bitset, col >> 5)
     cat_left = ((word >> (col & 31).astype(jnp.uint32)) & 1) == 1
@@ -151,6 +160,8 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
         node_default_left=tree.node_default_left.at[node].set(dleft),
         node_cat=tree.node_cat.at[node].set(is_cat),
         node_cat_bitset=tree.node_cat_bitset.at[node].set(bitset),
+        node_seg_lo=tree.node_seg_lo.at[node].set(seg_lo),
+        node_seg_hi=tree.node_seg_hi.at[node].set(seg_hi),
         node_left=node_left.at[node].set(~l),
         node_right=node_right.at[node].set(~new_leaf),
         node_gain=tree.node_gain.at[node].set(best.gain[l]),
@@ -232,7 +243,7 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
                      "with_interactions", "cegb_mode", "extra_trees",
                      "use_bynode", "tile_leaves", "hist_subtraction",
                      "feature_axis_name", "feature_shards", "voting",
-                     "vote_top_k"))
+                     "vote_top_k", "hist_dp"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
               feature_mask: jax.Array, missing_bin: jax.Array, *,
@@ -259,6 +270,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               feature_shards: int = 1,
               voting: bool = False,
               vote_top_k: int = 20,
+              bundle_meta=None,
+              forced_splits=None,
+              hist_dp: bool = False,
               ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
     """Grow one tree. Returns (tree arrays, per-row leaf index, aux state).
 
@@ -329,6 +343,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # --- feature-ownership slicing (FP learner, and DP's reduce-scatter)
     fp_mode = feature_axis_name is not None
     dp_scatter = fp_mode and (feature_axis_name == axis_name)
+    if bundle_meta is not None:
+        assert not fp_mode and not voting, (
+            "EFB bundles are not supported with distributed tree learners yet")
     if voting:
         assert axis_name is not None, "voting requires row sharding"
         assert not fp_mode, "voting and feature slicing are exclusive"
@@ -359,8 +376,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             return arr
         return jax.lax.dynamic_slice_in_dim(arr, off, f_loc, arr.ndim - 1)
 
+    # hist_dp: float64 histogram accumulation, the reference CPU precision
+    # model (hist_t, bin.h:32) / the gpu_use_dp flag's double mode; needs
+    # jax x64 (the caller warns otherwise)
+    hist_dtype = jnp.float64 if hist_dp else jnp.float32
     stats = jnp.stack([grad * sample_mask, hess * sample_mask, sample_mask],
-                      axis=1).astype(jnp.float32)
+                      axis=1).astype(hist_dtype)
     root = jnp.sum(stats, axis=0)
     if axis_name is not None:
         root = jax.lax.psum(root, axis_name)
@@ -374,12 +395,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     iota_l = jnp.arange(L, dtype=jnp.int32)
 
     def init_state() -> GrowState:
+        zf = functools.partial(jnp.zeros, dtype=hist_dtype)
         zero_best = find_best_splits(  # shape-consistent placeholder (all -inf)
-            jnp.zeros((L, f_loc, num_bins, 3), jnp.float32),
-            jnp.zeros((L,)), jnp.zeros((L,)), jnp.zeros((L,)), jnp.zeros((L,)),
+            zf((L, f_loc, num_bins, 3)),
+            zf((L,)), zf((L,)), zf((L,)), zf((L,)),
             jnp.zeros((L,), jnp.int32), meta_s, params,
             jnp.zeros((f_loc,), jnp.float32),
-            max_depth, with_categorical=False, cat_words=cat_words)
+            max_depth, with_categorical=False, cat_words=cat_words,
+            bundle=bundle_meta)
         if cegb_state is not None:
             used_split = cegb_state.used_split
             row_used = cegb_state.row_used
@@ -388,22 +411,27 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             row_used = jnp.zeros((n, f) if cegb_lazy else (1, 1), bool)
         return GrowState(
             leaf_id=jnp.zeros((n,), jnp.int32),
-            hist=jnp.zeros((L, f_loc, num_bins, 3), jnp.float32),
+            hist=jnp.zeros((L, f_loc, num_bins, 3), hist_dtype),
             hist_valid=jnp.zeros((L,), bool),
             leaf_dead=jnp.zeros((L,), bool),
-            leaf_sum_g=jnp.zeros((L,)).at[0].set(root[0]),
-            leaf_sum_h=jnp.zeros((L,)).at[0].set(root[1]),
-            leaf_cnt=jnp.zeros((L,)).at[0].set(root[2]),
-            leaf_output=jnp.zeros((L,)).at[0].set(root_out),
+            leaf_sum_g=zf((L,)).at[0].set(root[0]),
+            leaf_sum_h=zf((L,)).at[0].set(root[1]),
+            leaf_cnt=zf((L,)).at[0].set(root[2]),
+            leaf_output=zf((L,)).at[0].set(root_out),
             leaf_depth=jnp.zeros((L,), jnp.int32),
-            leaf_min=jnp.full((L,), -F32_MAX, jnp.float32),
-            leaf_max=jnp.full((L,), F32_MAX, jnp.float32),
+            leaf_min=jnp.full((L,), -F32_MAX, hist_dtype),
+            leaf_max=jnp.full((L,), F32_MAX, hist_dtype),
             used_path=jnp.zeros((L, f) if with_interactions else (1, 1), bool),
             used_split=used_split,
             row_used=row_used,
             sib=jnp.full((L,), -1, jnp.int32),
             parent_hist=jnp.zeros((L,), bool),
             done=jnp.bool_(False),
+            forced_idx=jnp.int32(0),
+            forced_slot=(jnp.full((forced_splits[0].shape[0],), -1,
+                                  jnp.int32).at[0].set(0)
+                         if forced_splits is not None
+                         else jnp.full((1,), -1, jnp.int32)),
             best=zero_best,
             tree=empty_tree(L, cat_words),
             num_leaves=jnp.int32(1),
@@ -420,7 +448,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # keep looping while there is histogram work or more splits may come;
         # ``done`` is set by a split phase that split nothing
         more = jnp.any(pending_mask(state)) | ~state.done
-        return (state.num_leaves < L) & more & (state.rounds < 2 * L + 8)
+        return (state.num_leaves < L) & more & (state.rounds < 3 * L + 8)
 
     def leaf_feature_mask(state: GrowState, round_key) -> jax.Array:
         """Per-(leaf, feature) validity: global column sampling x interaction
@@ -496,7 +524,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         sel = jnp.where(chosen_ok, chosen, -1)
 
         tile = histogram_tiles(bins_h, stats, state.leaf_id, sel, num_bins,
-                               method=hist_method)
+                               method=hist_method, dtype=hist_dtype)
         if dp_scatter:
             # the reference DP learner reduce-scatters histograms so each
             # machine receives only its owned features' global sums
@@ -590,7 +618,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             leaf_min=state.leaf_min if with_monotone else None,
             leaf_max=state.leaf_max if with_monotone else None,
             gain_adjust=slice_f(cegb_adjust(state)),
-            rand_bin=rand_bin)
+            rand_bin=rand_bin, bundle=bundle_meta)
         if fp_mode:
             # local feature index -> global, then allreduce-argmax of the
             # per-leaf bests (reference: SyncUpGlobalBestSplit,
@@ -632,6 +660,63 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                           (state, gain_eff))
         return state._replace(done=state.num_leaves == num_leaves_before)
 
+    def forced_phase(state: GrowState) -> GrowState:
+        """Apply one forced split (reference: SerialTreeLearner::ForceSplits,
+        serial_tree_learner.cpp:450-562): the node's (feature, threshold)
+        goes through the regular split machinery with the candidate set
+        restricted to the forced bin and min_gain disabled, so sums and
+        missing/default semantics are exact; a forced split its constraints
+        reject is skipped along with its whole subtree."""
+        ff, ft, fl, fr = forced_splits
+        k_idx = state.forced_idx
+        l = state.forced_slot[k_idx]
+        lsafe = jnp.maximum(l, 0)
+        fmask_forced = (jnp.arange(f_loc, dtype=jnp.int32)
+                        == ff[k_idx]).astype(jnp.float32)
+        # forced means forced: the reference gathers the threshold's sums
+        # directly (GatherInfoForThreshold) without min_gain/min_data
+        # screening, aborting only on gain < 0
+        params_forced = params._replace(
+            min_gain_to_split=jnp.float32(-1e30),
+            min_data_in_leaf=jnp.float32(0.0),
+            min_sum_hessian_in_leaf=jnp.float32(0.0))
+        best = find_best_splits(
+            state.hist, state.leaf_sum_g, state.leaf_sum_h,
+            state.leaf_cnt, state.leaf_output, state.leaf_depth,
+            meta_s, params_forced, fmask_forced, max_depth,
+            with_categorical=False, cat_words=cat_words,
+            leaf_min=state.leaf_min if with_monotone else None,
+            leaf_max=state.leaf_max if with_monotone else None,
+            rand_bin=jnp.full((L, f_loc), ft[k_idx], jnp.int32),
+            bundle=bundle_meta)
+        ok = ((l >= 0) & (state.num_leaves < L)
+              & state.hist_valid[lsafe] & ~state.leaf_dead[lsafe]
+              & jnp.isfinite(best.gain[lsafe]))
+        new_leaf = state.num_leaves
+        state = state._replace(best=best, rounds=state.rounds + 1)
+
+        def do_split(st):
+            ge = jnp.where(iota_l == lsafe, 1.0, NEG_INF)
+            st2, _ = _apply_split(st, bins, binsT, missing_bin, ge, meta,
+                                  with_monotone=with_monotone,
+                                  with_interactions=with_interactions,
+                                  cegb_lazy=cegb_lazy)
+            return st2
+
+        state = jax.lax.cond(ok, do_split, lambda s: s, state)
+        # children inherit slots (left keeps the split slot, right takes the
+        # new one); a skipped node kills its subtree (slot -1)
+        slot = state.forced_slot
+        flk, frk = fl[k_idx], fr[k_idx]
+        slot = slot.at[jnp.maximum(flk, 0)].set(
+            jnp.where(flk >= 0, jnp.where(ok, lsafe, -1),
+                      slot[jnp.maximum(flk, 0)]))
+        slot = slot.at[jnp.maximum(frk, 0)].set(
+            jnp.where(frk >= 0, jnp.where(ok, new_leaf, -1),
+                      slot[jnp.maximum(frk, 0)]))
+        return state._replace(forced_idx=k_idx + 1, forced_slot=slot,
+                              done=jnp.bool_(False))
+
     def outer_body(state: GrowState) -> GrowState:
         # BeforeFindBestSplit guards (serial_tree_learner.cpp:282-322): a
         # leaf failing the 2x min-data/min-hessian check is never
@@ -641,6 +726,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                  & (state.leaf_sum_h >= 2.0 * params.min_sum_hessian_in_leaf))
         newly_dead = active & ~state.hist_valid & ~state.leaf_dead & ~guard
         state = state._replace(leaf_dead=state.leaf_dead | newly_dead)
+        if forced_splits is not None:
+            k_total = forced_splits[0].shape[0]
+
+            def no_pending(st):
+                return jax.lax.cond(st.forced_idx < k_total,
+                                    forced_phase, split_phase, st)
+
+            return jax.lax.cond(jnp.any(pending_mask(state)),
+                                tile_pass, no_pending, state)
         return jax.lax.cond(jnp.any(pending_mask(state)),
                             tile_pass, split_phase, state)
 

@@ -184,8 +184,9 @@ class RF(GBDT):
         self._stacked_cache = None
 
     def predict_raw(self, X, num_iteration: Optional[int] = None,
-                    start_iteration: int = 0) -> np.ndarray:
-        """Average of tree outputs (average_output_, gbdt_prediction.cpp)."""
+                    start_iteration: int = 0, **_kwargs) -> np.ndarray:
+        """Average of tree outputs (average_output_, gbdt_prediction.cpp);
+        prediction early stop does not apply to averaged outputs."""
         from .tree import predict_value_bins
         bins = jnp.asarray(self.train_set.bin_new_data(X))
         k = self.num_tree_per_iteration

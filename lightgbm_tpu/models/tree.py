@@ -38,6 +38,8 @@ class TreeArrays(NamedTuple):
     node_count: jax.Array        # f32 [L-1]
     node_cat: jax.Array          # bool [L-1] categorical split flag
     node_cat_bitset: jax.Array   # uint32 [L-1, CAT_WORDS] bin membership (left side)
+    node_seg_lo: jax.Array       # int32 [L-1] EFB bundle segment start (-1 = regular)
+    node_seg_hi: jax.Array       # int32 [L-1] EFB bundle segment end (inclusive)
     leaf_value: jax.Array        # f32 [L] (shrinkage already applied by booster)
     leaf_weight: jax.Array       # f32 [L] sum_hessian
     leaf_count: jax.Array        # f32 [L]
@@ -59,6 +61,7 @@ def empty_tree(max_leaves: int, cat_words: int = 8) -> TreeArrays:
         node_count=f32(li),
         node_cat=jnp.zeros((li,), dtype=bool),
         node_cat_bitset=jnp.zeros((li, cat_words), dtype=jnp.uint32),
+        node_seg_lo=i32(li, -1), node_seg_hi=i32(li, -1),
         leaf_value=f32(lf), leaf_weight=f32(lf), leaf_count=f32(lf),
         leaf_depth=i32(lf), leaf_parent=i32(lf, -1),
         shrinkage=jnp.float32(1.0),
@@ -66,16 +69,24 @@ def empty_tree(max_leaves: int, cat_words: int = 8) -> TreeArrays:
 
 
 def _decide_left_bins(bin_val, threshold_bin, default_left, missing_bin,
-                      is_cat, cat_bitset):
+                      is_cat, cat_bitset, seg_lo=None, seg_hi=None):
     """Split decision in bin space.
 
     ``missing_bin``: per-feature bin routed by default direction (-1 when the
     feature has no missing routing; see ops/split.py mode analysis).
     Categorical: left iff the bin's bit is set in the membership bitset
     (reference: Tree::CategoricalDecision bitset FindInBitset, tree.h:133+).
+    ``seg_lo/seg_hi``: EFB bundle segment for bundle-column splits — rows
+    outside the owning member's bin range are that member's default mass and
+    route by ``default_left`` (the model-file analog is a missing_type=Zero
+    node, tree.h NumericalDecision).
     """
     num_default = (bin_val == missing_bin) & (missing_bin >= 0)
     num_left = jnp.where(num_default, default_left, bin_val <= threshold_bin)
+    if seg_lo is not None:
+        in_seg = (bin_val >= seg_lo) & (bin_val <= seg_hi)
+        bundle_left = jnp.where(in_seg, bin_val <= threshold_bin, default_left)
+        num_left = jnp.where(seg_lo >= 0, bundle_left, num_left)
     word = (bin_val >> 5).astype(jnp.int32)
     bit = (bin_val & 31).astype(jnp.int32)
     cat_words = jnp.take_along_axis(cat_bitset, word[:, None], axis=1)[:, 0]
@@ -107,7 +118,8 @@ def predict_leaf_bins(tree: TreeArrays, bins: jax.Array,
         b = bins[rows, feat].astype(jnp.int32)
         go_left = _decide_left_bins(
             b, tree.node_threshold_bin[node], tree.node_default_left[node],
-            missing_bin[feat], tree.node_cat[node], tree.node_cat_bitset[node])
+            missing_bin[feat], tree.node_cat[node], tree.node_cat_bitset[node],
+            tree.node_seg_lo[node], tree.node_seg_hi[node])
         nxt = jnp.where(go_left, tree.node_left[node], tree.node_right[node])
         nxt = jnp.where(active, nxt, cur)
         new_leaf = jnp.where(active & (nxt < 0), ~nxt, leaf)
@@ -128,17 +140,12 @@ def predict_value_bins(tree: TreeArrays, bins: jax.Array,
     return tree.leaf_value[leaf]
 
 
-def leaf_values_of_rows(leaf_value: jax.Array, leaf_id: jax.Array,
-                        block: int = 65536) -> jax.Array:
-    """Per-row tree output ``leaf_value[leaf_id]`` without a gather.
+import functools
 
-    XLA's gather from a small table costs ~90ms for 10M rows on a v5e (it
-    serializes); a blocked compare x matmul runs at memory bandwidth. Used
-    for the training-score update (the analog of Tree::AddPredictionToScore,
-    tree.h, which indexes the data partition instead).
-    """
-    if jax.default_backend() != "tpu":
-        return leaf_value[leaf_id]
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _leaf_values_of_rows_tpu(leaf_value: jax.Array, leaf_id: jax.Array,
+                             block: int) -> jax.Array:
     n = leaf_id.shape[0]
     l = leaf_value.shape[0]
     c = min(block, -(-n // 512) * 512)
@@ -152,6 +159,21 @@ def leaf_values_of_rows(leaf_value: jax.Array, leaf_id: jax.Array,
 
     _, vals = jax.lax.scan(body, 0, lid.reshape(-1, c))
     return vals.reshape(-1)[:n]
+
+
+def leaf_values_of_rows(leaf_value: jax.Array, leaf_id: jax.Array,
+                        block: int = 65536) -> jax.Array:
+    """Per-row tree output ``leaf_value[leaf_id]`` without a gather.
+
+    XLA's gather from a small table costs ~90ms for 10M rows on a v5e (it
+    serializes); a jitted blocked compare x matmul runs at memory bandwidth
+    (unjitted, the scan dispatches eagerly step by step — ~0.8s at 2M rows
+    through a TPU tunnel). Used for the training-score update (the analog of
+    Tree::AddPredictionToScore, tree.h, which indexes the data partition
+    instead)."""
+    if jax.default_backend() != "tpu":
+        return leaf_value[leaf_id]
+    return _leaf_values_of_rows_tpu(leaf_value, leaf_id, block)
 
 
 def stack_trees(trees: List[TreeArrays]) -> TreeArrays:

@@ -282,7 +282,9 @@ void* ltp_parse_file(const char* path, int has_header, int num_threads) {
   std::fseek(f, 0, SEEK_END);
   long size = std::ftell(f);
   std::fseek(f, 0, SEEK_SET);
-  std::vector<char> buf(static_cast<size_t>(size));
+  // +1 NUL terminator: strtod on the final token of a file without a
+  // trailing newline must not read past the buffer
+  std::vector<char> buf(static_cast<size_t>(size) + 1, '\0');
   size_t got = size > 0 ? std::fread(buf.data(), 1, size, f) : 0;
   std::fclose(f);
   return ParseBuffer(buf.data(), got, has_header, num_threads);
@@ -290,7 +292,12 @@ void* ltp_parse_file(const char* path, int has_header, int num_threads) {
 
 void* ltp_parse_buffer(const char* buf, int64_t len, int has_header,
                        int num_threads) {
-  return ParseBuffer(buf, static_cast<size_t>(len), has_header, num_threads);
+  // copy into a NUL-terminated buffer: the caller's memory need not be
+  // terminated and strtod can scan one past the last token
+  std::vector<char> owned(buf, buf + static_cast<size_t>(len));
+  owned.push_back('\0');
+  return ParseBuffer(owned.data(), static_cast<size_t>(len), has_header,
+                     num_threads);
 }
 
 int64_t ltp_rows(void* h) { return static_cast<ParseResult*>(h)->rows; }

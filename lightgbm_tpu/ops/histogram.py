@@ -80,13 +80,15 @@ def histogram_binloop(bins: jax.Array, stats: jax.Array, leaf_onehot: jax.Array,
     s = stats.shape[1]
     bins = bins.astype(jnp.int32)
 
+    acc_dtype = jnp.result_type(stats.dtype, leaf_onehot.dtype, jnp.float32)
+
     def body(b, acc):
-        mask = (bins == b).astype(jnp.float32)           # [N, F]
+        mask = (bins == b).astype(acc_dtype)             # [N, F]
         out = jnp.einsum("nl,nf,ns->lfs", leaf_onehot, mask, stats,
-                         preferred_element_type=jnp.float32)
+                         preferred_element_type=acc_dtype)
         return acc.at[:, :, b, :].set(out)
 
-    acc = jnp.zeros((l, f, num_bins, s), dtype=jnp.float32)
+    acc = jnp.zeros((l, f, num_bins, s), dtype=acc_dtype)
     return jax.lax.fori_loop(0, num_bins, body, acc)
 
 
@@ -126,7 +128,7 @@ def resolve_method(method: str) -> str:
 
 def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
                     sel: jax.Array, num_bins: int, method: str = "onehot",
-                    block: int = 16384) -> jax.Array:
+                    block: int = 16384, dtype=jnp.float32) -> jax.Array:
     """Histograms for a TILE of leaves.
 
     Slot ``p`` of the output accumulates the rows whose ``leaf_ids`` equals
@@ -163,19 +165,20 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
         def body(acc, xs):
             b, st, lid = xs
             oh = (b.astype(jnp.int32)[:, :, None] == iota_b[None, None, :]
-                  ).astype(jnp.float32).reshape(c, f * num_bins)
-            lo = (lid[:, None] == sel[None, :]).astype(jnp.float32)  # [C, P]
-            rhs = (lo[:, :, None] * st[:, None, :]).reshape(c, p * s)
+                  ).astype(dtype).reshape(c, f * num_bins)
+            lo = (lid[:, None] == sel[None, :]).astype(dtype)  # [C, P]
+            rhs = (lo[:, :, None] * st.astype(dtype)[:, None, :]
+                   ).reshape(c, p * s)
             # HIGHEST precision: TPU matmuls otherwise truncate inputs to
             # bf16, corrupting grad/hess sums ~0.5% (the one-hot side is
             # exact either way; counts accumulate exactly in f32 regardless)
             h = jax.lax.dot_general(oh, rhs, (((0,), (0,)), ((), ())),
                                     precision=jax.lax.Precision.HIGHEST,
-                                    preferred_element_type=jnp.float32)
+                                    preferred_element_type=dtype)
             return acc + h, None
 
         h, _ = jax.lax.scan(
-            body, jnp.zeros((f * num_bins, p * s), jnp.float32),
+            body, jnp.zeros((f * num_bins, p * s), dtype),
             (bins.reshape(nblk, c, f), stats.reshape(nblk, c, s),
              leaf_ids.reshape(nblk, c)))
         return h.reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
@@ -189,13 +192,12 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
         flat_idx = (slot[:, None] * f
                     + jnp.arange(f, dtype=jnp.int32)[None, :]) * num_bins \
             + bins.astype(jnp.int32)
-        contrib = jnp.broadcast_to(stats.astype(jnp.float32)[:, None, :],
+        contrib = jnp.broadcast_to(stats.astype(dtype)[:, None, :],
                                    (n, f, s))
-        hist = jnp.zeros(((p + 1) * f * num_bins, s), dtype=jnp.float32)
+        hist = jnp.zeros(((p + 1) * f * num_bins, s), dtype=dtype)
         hist = hist.at[flat_idx.reshape(-1)].add(contrib.reshape(-1, s))
         return hist.reshape(p + 1, f, num_bins, s)[:p]
     elif method == "binloop":
-        onehot = eq.astype(jnp.float32)
-        return histogram_binloop(bins, stats.astype(jnp.float32), onehot,
-                                 num_bins)
+        onehot = eq.astype(dtype)
+        return histogram_binloop(bins, stats.astype(dtype), onehot, num_bins)
     raise ValueError(f"unknown histogram method: {method}")

@@ -101,6 +101,25 @@ class SplitParams(NamedTuple):
         )
 
 
+class BundleMeta(NamedTuple):
+    """Per-(column, bin) EFB segment structure (bundling.py layout). For a
+    bundle column, bin ``b`` inside member ``f``'s range has ``seg_lo/seg_hi``
+    = that range's first/last bin; bins outside any member range (bundle bin
+    0) carry lo = hi = 0. Regular columns: lo = 0, hi = num_bin - 1 (which
+    makes the generalized directional sums reduce to the plain ones).
+    ``fwd_ok/rev_ok`` restrict threshold candidates per scan direction so
+    the bundle scan evaluates exactly the member feature's unbundled
+    candidate set (each original threshold once, with the member's
+    most-frequent mass — reconstructed from the leaf totals — on the side
+    its bin order dictates); built host-side in
+    basic.py _build_feature_meta_bundled."""
+    seg_lo: jax.Array        # int32 [F, B]
+    seg_hi: jax.Array        # int32 [F, B]
+    is_bundle: jax.Array     # bool [F]
+    fwd_ok: jax.Array        # bool [F, B]
+    rev_ok: jax.Array        # bool [F, B]
+
+
 class SplitInfo(NamedTuple):
     """Per-leaf best split, struct-of-arrays of shape [L]
     (reference: src/treelearner/split_info.hpp:22-90)."""
@@ -118,6 +137,8 @@ class SplitInfo(NamedTuple):
     right_output: jax.Array
     is_cat: jax.Array        # bool, categorical (bitset) split
     cat_bitset: jax.Array    # uint32[L, CAT_WORDS] categorical membership (0 when numerical)
+    seg_lo: jax.Array        # int32 [L]; EFB bundle segment start (-1 regular)
+    seg_hi: jax.Array        # int32 [L]; EFB bundle segment end (inclusive)
 
 
 CAT_BITSET_WORDS = 8  # default width (256 bins); widened when max_bin > 256
@@ -155,19 +176,41 @@ def leaf_gain(sum_g, sum_h, p: SplitParams, num_data, parent_output, lambda_l2=N
     return leaf_gain_given_output(sum_g, sum_h, out, p, lambda_l2)
 
 
-def _directional_sums(hist_excl, leaf_sum_g, leaf_sum_h, leaf_cnt):
+def _directional_sums(hist_excl, leaf_sum_g, leaf_sum_h, leaf_cnt,
+                      bundle: BundleMeta | None = None):
     """Cumulative left/right sums for every threshold, both directions.
 
     hist_excl: [L, F, B, 3] histogram with excluded bins zeroed.
     Returns dict with fwd/rev (accumulated-side eps added like the reference).
     Threshold t means: left = bins <= t (accumulated side fwd), right = bins > t.
+
+    With ``bundle``, the accumulated side is SEGMENT-relative: an EFB bundle
+    column interleaves many features' bin ranges, so the left mass at
+    threshold t inside member f's range is csum[t] - csum[seg_lo-1] and the
+    reverse-scan right mass is csum[seg_hi] - csum[t]. The complement side
+    comes from the leaf totals, which automatically assigns every
+    out-of-segment row (the member's most-frequent/default mass and the
+    other members' rows) to the scan's default direction — the same
+    total-minus-accumulated reconstruction as the reference's FixHistogram
+    (dataset.cpp) + SKIP_DEFAULT_BIN scans.
     """
     csum = jnp.cumsum(hist_excl, axis=2)                       # [L, F, B, 3]
     total_excl = csum[:, :, -1:, :]
-    # forward: left accumulates bins 0..t
-    fwd_left = csum
-    # reverse: right accumulates bins t+1..B-1 (of the non-excluded mass)
-    rev_right = total_excl - csum
+    if bundle is None:
+        # forward: left accumulates bins 0..t
+        fwd_left = csum
+        # reverse: right accumulates bins t+1..B-1 (of the non-excluded mass)
+        rev_right = total_excl - csum
+    else:
+        lo = bundle.seg_lo[None, :, :, None]                   # [1, F, B, 1]
+        hi = bundle.seg_hi[None, :, :, None]
+        lo_b = jnp.broadcast_to(jnp.maximum(lo - 1, 0), csum.shape)
+        hi_b = jnp.broadcast_to(hi, csum.shape)
+        csum_lo = jnp.where(lo > 0,
+                            jnp.take_along_axis(csum, lo_b, axis=2), 0.0)
+        csum_hi = jnp.take_along_axis(csum, hi_b, axis=2)
+        fwd_left = csum - csum_lo
+        rev_right = csum_hi - csum
     lt = dict(
         fwd_left_g=fwd_left[..., 0], fwd_left_h=fwd_left[..., 1] + K_EPSILON,
         fwd_left_c=fwd_left[..., 2],
@@ -450,6 +493,7 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
                      cat_words: int = CAT_BITSET_WORDS,
                      leaf_min=None, leaf_max=None,
                      gain_adjust=None, rand_bin=None,
+                     bundle: BundleMeta | None = None,
                      return_feature_gains: bool = False):
     """Best split per leaf over all numerical features.
 
@@ -483,7 +527,7 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
     excl = excl | (mode_a & is_zero)[None, :, None] & (bins == meta.default_bin[None, :, None])
     hist_excl = jnp.where(excl[:, :, :, None], 0.0, hist)
 
-    s = _directional_sums(hist_excl, leaf_sum_g, leaf_sum_h, leaf_cnt)
+    s = _directional_sums(hist_excl, leaf_sum_g, leaf_sum_h, leaf_cnt, bundle)
 
     parent_out = leaf_output[:, None, None]
 
@@ -530,6 +574,12 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
     zero_thr_skip = (mode_a & is_zero)[None, :, None] & (bins == meta.default_bin[None, :, None])
     fwd_ok = fwd_ok & ~zero_thr_skip
     rev_ok = rev_ok & ~zero_thr_skip
+    if bundle is not None:
+        # bundle columns: per-bin direction masks reproduce each member's
+        # unbundled candidate set exactly (see BundleMeta docstring)
+        isb = bundle.is_bundle[None, :, None]
+        fwd_ok = jnp.where(isb, bundle.fwd_ok[None, :, :], fwd_ok)
+        rev_ok = jnp.where(isb, bundle.rev_ok[None, :, :], rev_ok)
     if rand_bin is not None:   # extra_trees: only the random threshold
         rb = rand_bin[:, :, None]
         fwd_ok = fwd_ok & (bins == rb)
@@ -612,6 +662,14 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
     nan_single = (is_nan & ~mode_a)[bf]
     default_left = (bdir == 0) & ~nan_single
 
+    if bundle is not None:
+        chose_bundle = bundle.is_bundle[bf]
+        seg_lo_out = jnp.where(chose_bundle, bundle.seg_lo[bf, bt], -1)
+        seg_hi_out = jnp.where(chose_bundle, bundle.seg_hi[bf, bt], -1)
+    else:
+        seg_lo_out = jnp.full((L,), -1, jnp.int32)
+        seg_hi_out = jnp.full((L,), -1, jnp.int32)
+
     num_info = SplitInfo(
         gain=best_gain.astype(jnp.float32),
         feature=bf,
@@ -622,6 +680,8 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
         left_output=left_out, right_output=right_out,
         is_cat=jnp.zeros((L,), dtype=bool),
         cat_bitset=jnp.zeros((L, cat_words), dtype=jnp.uint32),
+        seg_lo=seg_lo_out.astype(jnp.int32),
+        seg_hi=seg_hi_out.astype(jnp.int32),
     )
     if not with_categorical:
         if return_feature_gains:
@@ -667,6 +727,8 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
         right_output=sel(cro, num_info.right_output),
         is_cat=take_cat,
         cat_bitset=sel(cbits, num_info.cat_bitset),
+        seg_lo=sel(jnp.full((L,), -1, jnp.int32), num_info.seg_lo),
+        seg_hi=sel(jnp.full((L,), -1, jnp.int32), num_info.seg_hi),
     )
     if return_feature_gains:
         return merged, per_feature_best_gain_key(gain_rev, gain_fwd)

@@ -1,0 +1,119 @@
+"""Training-phase profiling: named timer scopes + aggregated table.
+
+The TPU analog of the reference's ``Common::Timer`` / ``FunctionTimer`` RAII
+scopes around every training phase and the ``global_timer`` table printed at
+exit under ``USE_TIMETAG`` (reference: include/LightGBM/utils/common.h:953-1037,
+src/boosting/gbdt.cpp:20). Here each scope also opens a
+``jax.profiler.TraceAnnotation`` so the phases show up in device traces
+captured with ``jax.profiler.trace``.
+
+Enabled via the ``LIGHTGBM_TPU_TIMETAG`` env var or
+``profiling.enable()``. When enabled, scope exit BLOCKS on the values passed
+to ``sync`` (host wall time of an async dispatch is meaningless otherwise) —
+like USE_TIMETAG, profiling adds overhead.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional
+
+_enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+_acc: Dict[str, float] = defaultdict(float)
+_cnt: Dict[str, int] = defaultdict(int)
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    _acc.clear()
+    _cnt.clear()
+
+
+@contextmanager
+def timer(name: str, sync=None) -> Iterator[None]:
+    """Named scope. ``sync``: optional array (or pytree) whose value is
+    fetched at scope exit so the measured time covers the device work
+    dispatched inside the scope."""
+    if not _enabled:
+        yield
+        return
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                try:
+                    jax.block_until_ready(sync)
+                    # a host fetch is the only reliable barrier through some
+                    # TPU tunnels; fetch one scalar
+                    leaves = jax.tree_util.tree_leaves(sync)
+                    if leaves:
+                        _ = float(leaves[0].ravel()[0])
+                except Exception:
+                    pass
+            _acc[name] += time.time() - t0
+            _cnt[name] += 1
+
+
+class timer_sync:
+    """Like ``timer`` but the sync value is produced inside the scope:
+    ``with timer_sync("x") as t: ...; t.sync(arr)``."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._sync = None
+
+    def sync(self, value) -> None:
+        self._sync = value
+
+    def __enter__(self):
+        self._cm = timer(self.name, None)
+        self._cm.__enter__()
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled and self._sync is not None:
+            import jax
+            try:
+                jax.block_until_ready(self._sync)
+                leaves = jax.tree_util.tree_leaves(self._sync)
+                if leaves:
+                    _ = float(leaves[0].ravel()[0])
+            except Exception:
+                pass
+        return self._cm.__exit__(*exc)
+
+
+def table() -> str:
+    """Aggregated per-scope wall-time table (reference: the USE_TIMETAG
+    summary printed by ~Timer, common.h:970-990)."""
+    if not _acc:
+        return "(no timer scopes recorded)"
+    width = max(len(k) for k in _acc)
+    lines = [f"{'scope'.ljust(width)}  {'calls':>7}  {'total s':>10}  "
+             f"{'mean ms':>10}"]
+    for name in sorted(_acc, key=lambda k: -_acc[k]):
+        n = _cnt[name]
+        lines.append(f"{name.ljust(width)}  {n:>7}  {_acc[name]:>10.3f}  "
+                     f"{1e3 * _acc[name] / max(n, 1):>10.2f}")
+    return "\n".join(lines)
+
+
+def print_table() -> None:
+    from . import log
+    for line in table().splitlines():
+        log.info(line)

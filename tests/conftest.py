@@ -20,6 +20,17 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """XLA's CPU compiler has been observed to segfault after compiling many
+    hundreds of programs in one long process (jaxlib 0.9, during
+    backend_compile_and_load); dropping the jit caches between test modules
+    keeps the program count bounded. CI should still prefer per-file pytest
+    processes (tests/run_suite.sh)."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)

@@ -1,0 +1,11 @@
+#!/bin/sh
+# Run the test suite one pytest process per file. Isolates the XLA-CPU
+# compiler's many-programs segfault (see conftest.py) and makes a crash
+# attributable to a single file instead of killing the whole run.
+set -u
+fail=0
+for f in "$(dirname "$0")"/test_*.py; do
+  echo "=== $f"
+  python -u -m pytest "$f" -q --no-header || fail=1
+done
+exit $fail

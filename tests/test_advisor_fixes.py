@@ -1,0 +1,122 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+native parser buffer termination, iterative TreeSHAP, pandas-categorical
+continued-training validation, whitespace CLI headers."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def test_parser_no_trailing_newline(tmp_path):
+    """Files whose last line has no newline must parse completely (the
+    parser NUL-terminates its buffer so strtod cannot over-read)."""
+    from lightgbm_tpu.native import native_available, parse_text_file
+    if not native_available():
+        pytest.skip("native parser unavailable")
+    p = tmp_path / "nonl.csv"
+    with open(p, "wb") as fh:
+        fh.write(b"1,2.5,3\n4,5.5,6.125")        # no trailing newline
+    X, fmt = parse_text_file(str(p), has_header=False)
+    assert fmt == "csv"
+    np.testing.assert_allclose(X, [[1, 2.5, 3], [4, 5.5, 6.125]])
+
+
+def test_parser_buffer_no_trailing_newline():
+    from lightgbm_tpu import native
+    if not native.native_available():
+        pytest.skip("native parser unavailable")
+    lib = native._load()
+    buf = b"7.5,8\n9,10.25"
+    h = lib.ltp_parse_buffer(buf, len(buf), 0, 1)
+    assert h
+    try:
+        rows, cols = lib.ltp_rows(h), lib.ltp_cols(h)
+        arr = np.ctypeslib.as_array(lib.ltp_data(h), shape=(rows, cols)).copy()
+    finally:
+        lib.ltp_free(h)
+    np.testing.assert_allclose(arr, [[7.5, 8], [9, 10.25]])
+
+
+def test_deep_tree_shap_no_recursion_error():
+    """TreeSHAP must not consume Python stack proportional to tree depth
+    (iterative walker): run it under a tiny recursion limit that the old
+    per-node recursion could not survive, and check contributions sum to the
+    raw score."""
+    import sys
+    rng = np.random.RandomState(0)
+    n = 600
+    X = np.arange(n, dtype=np.float64).reshape(-1, 1)
+    y = np.exp(0.04 * np.arange(n))              # skewed -> deep-ish tree
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 2,
+                                         "verbosity": -1})
+    booster = lgb.train({"objective": "regression", "num_leaves": 120,
+                         "min_data_in_leaf": 2, "min_sum_hessian_in_leaf": 0.0,
+                         "verbosity": -1}, ds, num_boost_round=1)
+    ht = booster._boosting.host_trees[0]
+    depth = int(np.max(ht.leaf_depth))
+    assert depth > 10, depth
+    from lightgbm_tpu.io.model_text import ModelTree
+    from lightgbm_tpu.io.shap import tree_shap_values_batch
+    mt = ModelTree.from_host(ht, ds.mappers)
+    old = sys.getrecursionlimit()
+    base = len(__import__("inspect").stack())
+    sys.setrecursionlimit(base + 30)             # < depth * frames/node
+    try:
+        contrib = tree_shap_values_batch(mt, X[:50], 1)
+    finally:
+        sys.setrecursionlimit(old)
+    raw = booster.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5,
+                               atol=1e-6 * np.abs(raw).max())
+
+
+def test_pandas_categorical_continued_training_mismatch():
+    pd = pytest.importorskip("pandas")
+    rng = np.random.RandomState(1)
+    n = 400
+
+    vals_num = rng.normal(size=n)
+    vals_cat = rng.choice(["a", "b", "c"], size=n)
+
+    def frame(order):
+        # the SAME rows, expressed with a different category-list order
+        # (so codes differ even though the data is identical)
+        return pd.DataFrame({
+            "num": vals_num,
+            "cat": pd.Categorical(vals_cat, categories=order),
+        })
+
+    y = rng.normal(size=n)
+    df1 = frame(["a", "b", "c"])
+    b1 = lgb.train({"objective": "regression", "num_leaves": 8,
+                    "verbosity": -1},
+                   lgb.Dataset(df1, label=y, params={"verbosity": -1}),
+                   num_boost_round=3)
+
+    # unconstructed continuation dataset adopts the init model's lists
+    df2 = frame(["c", "b", "a"])                  # different category order
+    ds2 = lgb.Dataset(df2, label=y, params={"verbosity": -1})
+    b2 = lgb.train({"objective": "regression", "num_leaves": 8,
+                    "verbosity": -1}, ds2, num_boost_round=2, init_model=b1)
+    # with adopted lists, the ensemble's predictions on the SAME rows match
+    # regardless of which frame ordering carries them
+    np.testing.assert_allclose(b2.predict(df1), b2.predict(df2), rtol=1e-6)
+
+    # an already-constructed dataset with mismatching lists must fail loudly
+    ds3 = lgb.Dataset(frame(["c", "b", "a"]), label=y,
+                      params={"verbosity": -1}, free_raw_data=False)
+    ds3.construct()
+    with pytest.raises(LightGBMError, match="categorical"):
+        lgb.train({"objective": "regression", "num_leaves": 8,
+                   "verbosity": -1}, ds3, num_boost_round=2, init_model=b1)
+
+
+def test_cli_whitespace_header(tmp_path):
+    from lightgbm_tpu.cli import _read_header
+    from lightgbm_tpu.config import Config
+    p = tmp_path / "data.txt"
+    p.write_text("label f0 f1 f2\n1 0.5 0.25 0.125\n")
+    cfg = Config.from_params({"header": True})
+    assert _read_header(str(p), cfg) == ["label", "f0", "f1", "f2"]

@@ -1,0 +1,135 @@
+"""Exclusive Feature Bundling + sparse input tests
+(reference: dataset.cpp:100-303 FindGroups/FastFeatureBundling,
+sparse_bin.hpp storage; VERDICT r2 item 5)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.bundling import fast_feature_bundling
+
+sp = pytest.importorskip("scipy.sparse")
+
+
+def _onehotish(rng, n, f, density=0.02):
+    """Mutually sparse columns: each row activates a few features."""
+    m = sp.random(n, f, density=density, random_state=rng, format="csr",
+                  data_rvs=lambda k: rng.uniform(0.5, 2.0, k))
+    return m
+
+
+def test_greedy_bundling_exclusive_features():
+    """Perfectly exclusive features must land in one bundle."""
+    rows = [np.array([0, 1, 2]), np.array([3, 4, 5]), np.array([6, 7])]
+    bundles = fast_feature_bundling(rows, [3, 4, 5], np.ones(3, bool), 100)
+    assert len(bundles) == 1
+    b = bundles[0]
+    assert sorted(b.members) == [0, 1, 2]
+    # bin 0 shared; each member = 1 phantom + (num_bin - 1) data bins
+    assert b.num_bin == 1 + 3 + 4 + 5
+
+
+def test_conflicting_features_not_bundled():
+    rows = [np.arange(60), np.arange(50, 100)]   # 10 overlapping rows
+    bundles = fast_feature_bundling(rows, [3, 3], np.ones(2, bool), 100)
+    assert len(bundles) == 2
+
+
+def test_sparse_construct_no_densify():
+    rng = np.random.RandomState(0)
+    X = _onehotish(rng, 2000, 300, density=0.01)
+    y = (np.asarray(X.sum(axis=1)).ravel() > 0.2).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5,
+                                         "verbosity": -1})
+    ds.construct()
+    assert ds.bundles is not None
+    ncols = ds.num_used_features()
+    nused = len(ds.used_features)
+    assert ncols < nused, (ncols, nused)   # bundling actually merged columns
+    booster = lgb.train({"objective": "binary", "num_leaves": 8,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        ds, num_boost_round=5)
+    pred_sparse = booster.predict(X, raw_score=True)
+    pred_dense = booster.predict(X.toarray(), raw_score=True)
+    np.testing.assert_allclose(pred_sparse, pred_dense, rtol=1e-6)
+    assert np.std(pred_sparse) > 0
+
+
+def test_bundled_matches_unbundled_training():
+    """Small-case parity: with a zero conflict budget the bundled model must
+    equal training on the same data with bundling disabled (VERDICT 'Done'
+    criterion)."""
+    rng = np.random.RandomState(1)
+    n, f = 1500, 40
+    X = _onehotish(rng, n, f, density=0.03)
+    w = rng.normal(size=f)
+    y = (X @ w + 0.1 * rng.normal(size=n) > 0).astype(np.float64)
+
+    def fit(data, extra):
+        ds = lgb.Dataset(data, label=y, params={"min_data_in_leaf": 5,
+                                                "verbosity": -1, **extra})
+        return lgb.train({"objective": "binary", "num_leaves": 8,
+                          "min_data_in_leaf": 5, "verbosity": -1, **extra},
+                         ds, num_boost_round=8)
+
+    b_bundled = fit(X, {})
+    b_plain = fit(X.toarray(), {})
+    ds_check = b_bundled._boosting.train_set
+    assert ds_check.bundles is not None
+    assert ds_check.num_used_features() < len(ds_check.used_features)
+    Xt = _onehotish(np.random.RandomState(2), 500, f, density=0.03).toarray()
+    np.testing.assert_allclose(b_bundled.predict(Xt, raw_score=True),
+                               b_plain.predict(Xt, raw_score=True),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_enable_bundle_false_on_sparse():
+    rng = np.random.RandomState(3)
+    X = _onehotish(rng, 800, 50, density=0.05)
+    y = rng.normal(size=800)
+    ds = lgb.Dataset(X, label=y, params={"enable_bundle": False,
+                                         "verbosity": -1})
+    ds.construct()
+    # sparse path still used (no densify) but every column is a single
+    assert ds.bundles is not None
+    assert all(len(b.members) == 1 for b in ds.bundles)
+
+
+def test_bundled_model_text_roundtrip(tmp_path):
+    """Saved models are bundle-free (original features, real thresholds) and
+    reload to the same predictions."""
+    rng = np.random.RandomState(4)
+    n, f = 1200, 30
+    X = _onehotish(rng, n, f, density=0.05)
+    y = (np.asarray(X.sum(axis=1)).ravel()
+         + 0.1 * rng.normal(size=n) > 0.5).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5,
+                                         "verbosity": -1})
+    booster = lgb.train({"objective": "binary", "num_leaves": 8,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        ds, num_boost_round=5)
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    Xt = X.toarray()[:200]
+    np.testing.assert_allclose(loaded.predict(Xt, raw_score=True),
+                               booster.predict(Xt, raw_score=True),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_allstate_shaped_constructs_and_trains():
+    """A wide-sparse synthetic (VERDICT: 'Allstate-shaped ... constructs
+    within memory, bundles to O(100) effective columns, trains'). Scaled to
+    test-size (the full 13.2Mx4228 is the benchmark's job)."""
+    rng = np.random.RandomState(5)
+    n, f = 60_000, 2000
+    X = _onehotish(rng, n, f, density=0.001)   # ~99.9% sparse
+    y = (np.asarray((X != 0).sum(axis=1)).ravel() % 2).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    ds.construct()
+    ncols = ds.num_used_features()
+    assert ncols <= 200, ncols
+    booster = lgb.train({"objective": "binary", "num_leaves": 16,
+                         "verbosity": -1}, ds, num_boost_round=3)
+    p = booster.predict(X[:100], raw_score=True)
+    assert p.shape == (100,)
