@@ -333,7 +333,13 @@ class Dataset:
             self._run_bundling(Xs, len(sample), config)
             self._build_feature_meta_bundled(config)
 
-        bins_np = self._bin_columns(X)
+        if self.bundles is None:
+            # reference was constructed dense (no EFB bundles): bin through
+            # the per-feature mappers column-wise so this sparse valid set
+            # aligns with the reference's [N, F_used] layout
+            bins_np = self._bin_columns_unbundled(X)
+        else:
+            bins_np = self._bin_columns(X)
         dtype = np.uint8 if self.max_num_bins <= 256 else np.int32
         self.bins = jnp.asarray(bins_np.astype(dtype))
         self.raw_data_np = None
@@ -553,6 +559,28 @@ class Dataset:
                     out[np.asarray(rows)[sel], gi] = off + 1 + bb
         return out
 
+    def _bin_columns_unbundled(self, X) -> np.ndarray:
+        """Raw matrix -> UNBUNDLED bin matrix [N, F_used] through the
+        per-feature mappers, column-wise without densifying sparse input
+        (the valid-against-dense-reference path: the reference has no EFB
+        bundles, so device column i is used feature i directly)."""
+        assert _is_scipy_sparse(X), "dense input takes the dense bin path"
+        X = X.tocsc()
+        n = X.shape[0]
+        f = max(len(self.used_features), 1)
+        out = np.zeros((n, f), dtype=np.int32)
+        for i, j in enumerate(self.used_features):
+            j = int(j)
+            m = self.mappers[j]
+            rows = X.indices[X.indptr[j]:X.indptr[j + 1]]
+            vals = np.asarray(X.data[X.indptr[j]:X.indptr[j + 1]],
+                              dtype=np.float64)
+            # implicit zeros take the bin of value 0 (bin.h GetDefaultBin)
+            out[:, i] = m.default_bin
+            if len(rows):
+                out[rows, i] = m.values_to_bins(vals)
+        return out
+
     @property
     def bundle_meta(self):
         self.construct()
@@ -646,6 +674,12 @@ class Dataset:
                           f"not the same as it was in training data "
                           f"({self.num_total_features}).")
             return self._bin_columns(X)
+        if _is_scipy_sparse(X):
+            if X.shape[1] != self.num_total_features:
+                log.fatal(f"The number of features in data ({X.shape[1]}) is "
+                          f"not the same as it was in training data "
+                          f"({self.num_total_features}).")
+            return self._bin_columns_unbundled(X)
         X = _to_2d_float(self._pandas_to_codes(X))
         if X.shape[1] != self.num_total_features:
             log.fatal(f"The number of features in data ({X.shape[1]}) is not the same"

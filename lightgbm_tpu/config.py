@@ -302,7 +302,7 @@ class Config:
     # models/grower.py docstring); "exact": strict best-first like the
     # reference's leaf-wise order (one histogram round per split).
     tree_growth_mode: str = "batched"
-    histogram_method: str = "auto"                  # auto|scatter|binloop|onehot
+    histogram_method: str = "auto"                  # auto|scatter|binloop|onehot|onehot_hilo|pallas|pallas_hilo
 
     def __post_init__(self):
         if self.seed is not None:

@@ -33,7 +33,7 @@ from ..ops.split import SplitParams
 from ..utils import log
 from .grower import grow_tree
 from .tree import (HostTree, TreeArrays, predict_leaf_bins, predict_value_bins,
-                   stack_trees)
+                   predict_values_stacked, stack_trees)
 
 
 class GBDT:
@@ -293,6 +293,9 @@ class GBDT:
         self.shrinkage_rate = config.learning_rate
         self.split_params = SplitParams.from_config(config)
         if self.train_set is not None:
+            # _setup_learner_features ends by re-running _setup_tree_learner,
+            # so a config change enabling an option the active parallel
+            # learner rejects fails loudly here
             self._setup_learner_features(self.train_set)
         self._need_bagging = (config.bagging_freq > 0 and config.bagging_fraction < 1.0) or \
             (config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0)
@@ -420,7 +423,7 @@ class GBDT:
             return self._parallel_grower(
                 ts.bins, gc, hc, mask,
                 ts.feature_meta, self.split_params, fmask, ts.missing_bin,
-                binsT=ts.bins_T if hm == "onehot" else None,
+                binsT=ts.bins_T if hm.startswith(("onehot", "pallas")) else None,
                 rng_key=iter_key,
                 max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
                 max_depth=cfg.max_depth, hist_method=hm,
@@ -433,7 +436,7 @@ class GBDT:
             ts.feature_meta, self.split_params, fmask, ts.missing_bin,
             max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
             max_depth=cfg.max_depth, hist_method=hm,
-            binsT=ts.bins_T if hm == "onehot" else None,
+            binsT=ts.bins_T if hm.startswith(("onehot", "pallas")) else None,
             exact=cfg.tree_growth_mode == "exact",
             with_categorical=ts.has_categorical,
             with_monotone=self._with_monotone,
@@ -858,19 +861,54 @@ class GBDT:
         out = np.zeros((n, k), dtype=np.float64)
         mb = self.train_set.missing_bin
         active = np.ones(n, dtype=bool)
-        for it in range(start_iteration, end_iter):
+        # iterations from a loaded init model walk host trees (their bin
+        # thresholds belong to a different mapper space); the numpy walker
+        # needs a dense matrix
+        if start_iteration < min(end_iter, self.loaded_iters):
+            from ..basic import _is_scipy_sparse
+            if _is_scipy_sparse(X):
+                X = np.asarray(X.todense())
+        it = start_iteration
+        while it < min(end_iter, self.loaded_iters):
             for c in range(k):
-                if it < self.loaded_iters:
-                    delta = self.loaded.trees[it * k + c].predict(X)
-                else:
-                    tree = self.trees[(it - self.loaded_iters) * k + c]
-                    delta = np.asarray(predict_value_bins(tree, bins, mb))
+                delta = self.loaded.trees[it * k + c].predict(X)
                 _accumulate_active(out, c, delta, active, pred_early_stop)
+            it += 1
             if pred_early_stop and \
-                    (it - start_iteration + 1) % pred_early_stop_freq == 0:
+                    (it - start_iteration) % pred_early_stop_freq == 0:
                 active &= ~_early_stop_mask(out, k, pred_early_stop_margin)
                 if not active.any():
-                    break
+                    return out if k > 1 else out[:, 0]
+        # own trees: a handful of batched device dispatches (bounded by the
+        # early-stop check period and a [t, n] buffer cap) via the stacked
+        # ensemble scan — not one round trip per tree. Per-tree values come
+        # back and accumulate in float64 in tree order, bit-identical to the
+        # per-tree path.
+        if it < end_iter:
+            stacked = self._stacked()
+            max_chunk_iters = max(1, 64 * 1024 * 1024 // max(n * k, 1))
+            while it < end_iter:
+                ce = min(end_iter, it + max_chunk_iters)
+                if pred_early_stop:
+                    past = it - start_iteration
+                    nxt = start_iteration + (past // pred_early_stop_freq
+                                             + 1) * pred_early_stop_freq
+                    ce = min(ce, nxt)
+                a = (it - self.loaded_iters) * k
+                b = (ce - self.loaded_iters) * k
+                chunk = jax.tree.map(lambda x: x[a:b], stacked)
+                vals = np.asarray(predict_values_stacked(chunk, bins, mb),
+                                  dtype=np.float64)              # [t, n]
+                for ti in range(b - a):
+                    _accumulate_active(out, ti % k, vals[ti], active,
+                                       pred_early_stop)
+                it = ce
+                if pred_early_stop and \
+                        (it - start_iteration) % pred_early_stop_freq == 0:
+                    active &= ~_early_stop_mask(out, k,
+                                                pred_early_stop_margin)
+                    if not active.any():
+                        break
         return out if k > 1 else out[:, 0]
 
     def predict(self, X, raw_score: bool = False,

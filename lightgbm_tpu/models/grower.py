@@ -335,7 +335,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     """
     n, f = bins.shape
     L = max_leaves
-    P = min(tile_leaves, L) if hist_method == "onehot" else L
+    P = min(tile_leaves, L) if hist_method.startswith(("onehot", "pallas")) \
+        else L
     cat_words = max(1, -(-num_bins // 32))
     cegb_lazy = cegb_mode == "lazy"
     cegb_on = cegb_mode != "off"
@@ -365,10 +366,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         bins_h = (bins if dp_scatter
                   else jax.lax.dynamic_slice(bins, (jnp.int32(0), off),
                                              (n, f_loc)))
+        binsT_h = None if binsT is None else (
+            binsT if dp_scatter
+            else jax.lax.dynamic_slice_in_dim(binsT, off, f_loc, 0))
     else:
         f_loc, off = f, None
         meta_s, missing_bin_s = meta, missing_bin
         bins_h = bins
+        binsT_h = binsT
 
     def slice_f(arr):
         """Slice a per-feature trailing axis to the local feature shard."""
@@ -444,11 +449,17 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     def pending_mask(state: GrowState) -> jax.Array:
         return (active_mask(state) & ~state.hist_valid & ~state.leaf_dead)
 
+    # each forced node consumes one round even when its subtree is dead, so
+    # the cap grows by the forced-node count (otherwise a forcedsplits file
+    # with more nodes than ~3*L silently truncates growth)
+    k_forced = forced_splits[0].shape[0] if forced_splits is not None else 0
+    max_rounds = 3 * L + 8 + k_forced
+
     def outer_cond(state: GrowState) -> jax.Array:
         # keep looping while there is histogram work or more splits may come;
         # ``done`` is set by a split phase that split nothing
         more = jnp.any(pending_mask(state)) | ~state.done
-        return (state.num_leaves < L) & more & (state.rounds < 3 * L + 8)
+        return (state.num_leaves < L) & more & (state.rounds < max_rounds)
 
     def leaf_feature_mask(state: GrowState, round_key) -> jax.Array:
         """Per-(leaf, feature) validity: global column sampling x interaction
@@ -524,7 +535,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         sel = jnp.where(chosen_ok, chosen, -1)
 
         tile = histogram_tiles(bins_h, stats, state.leaf_id, sel, num_bins,
-                               method=hist_method, dtype=hist_dtype)
+                               method=hist_method, dtype=hist_dtype,
+                               binsT=binsT_h)
         if dp_scatter:
             # the reference DP learner reduce-scatters histograms so each
             # machine receives only its owned features' global sums

@@ -155,7 +155,15 @@ def _leaf_values_of_rows_tpu(leaf_value: jax.Array, leaf_id: jax.Array,
 
     def body(_, lid_blk):
         oh = (lid_blk[:, None] == iota[None, :]).astype(jnp.float32)
-        return _, oh @ leaf_value
+        # HIGHEST precision: the default TPU matmul would bf16-round
+        # leaf_value (~0.4% rel) in every train-score update, biasing
+        # gradients each iteration (the reference accumulates scores in
+        # double, score_updater.hpp)
+        vals = jax.lax.dot_general(
+            oh, leaf_value[:, None], (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)[:, 0]
+        return _, vals
 
     _, vals = jax.lax.scan(body, 0, lid.reshape(-1, c))
     return vals.reshape(-1)[:n]
@@ -193,6 +201,25 @@ def predict_value_ensemble(stacked: TreeArrays, bins: jax.Array,
 
     total, _ = jax.lax.scan(step, jnp.zeros((bins.shape[0],), jnp.float32), stacked)
     return total
+
+
+@jax.jit
+def predict_values_stacked(stacked: TreeArrays, bins: jax.Array,
+                           missing_bin: jax.Array) -> jax.Array:
+    """Per-tree outputs over a stacked ensemble in ONE device program (the
+    batched analog of GBDT::PredictRaw's per-tree loop,
+    gbdt_prediction.cpp:13-53 — a 500-tree predict is a handful of
+    dispatches, not 500 tunnel round trips). The per-tree values are
+    returned (not summed on device) so the caller can accumulate in float64
+    in tree order, bit-identical to the host per-tree path.
+
+    Returns [T, N] float32.
+    """
+    def step(_, tree):
+        return _, predict_value_bins(tree, bins, missing_bin)
+
+    _, vals = jax.lax.scan(step, 0, stacked)
+    return vals
 
 
 # --------------------------------------------------------------------- host

@@ -120,15 +120,20 @@ def resolve_method(method: str) -> str:
     (the analog of the reference's col-wise/row-wise auto benchmark,
     dataset.cpp:591-689 TestMultiThreadingMethod — here the choice is
     platform-structural: scatter-add is fast on CPU hosts and pathologically
-    serialized on TPU, where the one-hot contraction wins)."""
+    serialized on TPU, where the fused Pallas kernel wins; measured on v5e
+    at Higgs shape the ladder is pallas_hilo < pallas ~ onehot << scatter).
+    ``histogram_tiles`` falls back from a pallas method to the equivalent
+    XLA onehot contraction when the kernel's preconditions don't hold
+    (non-TPU backend, no feature-major bins, f64 accumulation)."""
     if method == "auto":
-        return "onehot" if jax.default_backend() == "tpu" else "scatter"
+        return "pallas_hilo" if jax.default_backend() == "tpu" else "scatter"
     return method
 
 
 def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
                     sel: jax.Array, num_bins: int, method: str = "onehot",
-                    block: int = 16384, dtype=jnp.float32) -> jax.Array:
+                    block: int = 16384, dtype=jnp.float32,
+                    binsT: jax.Array | None = None) -> jax.Array:
     """Histograms for a TILE of leaves.
 
     Slot ``p`` of the output accumulates the rows whose ``leaf_ids`` equals
@@ -152,7 +157,22 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
     p = sel.shape[0]
     s = stats.shape[1]
 
-    if method == "onehot":
+    if method in ("pallas", "pallas_hilo"):
+        # the fused kernel needs: real TPU lowering, the feature-major bin
+        # matrix, f32 accumulation, and the tile x stat channels within one
+        # 128-lane group; otherwise run the XLA onehot formulation of the
+        # same contraction
+        from . import pallas_hist
+        if (jax.default_backend() == "tpu" and binsT is not None
+                and dtype == jnp.float32 and p * s <= 128):
+            fn = (pallas_hist.histogram_tiles_pallas_hilo
+                  if method == "pallas_hilo"
+                  else pallas_hist.histogram_tiles_pallas)
+            return fn(binsT, stats, leaf_ids, sel, num_bins)
+        method = "onehot_hilo" if method == "pallas_hilo" else "onehot"
+
+    if method in ("onehot", "onehot_hilo"):
+        hilo = method == "onehot_hilo" and dtype == jnp.float32
         c = min(block, _round_up(max(n, 1), 512))
         pad = _round_up(n, c) - n
         if pad:
@@ -164,17 +184,38 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
 
         def body(acc, xs):
             b, st, lid = xs
-            oh = (b.astype(jnp.int32)[:, :, None] == iota_b[None, None, :]
-                  ).astype(dtype).reshape(c, f * num_bins)
+            oh_bool = (b.astype(jnp.int32)[:, :, None] == iota_b[None, None, :])
             lo = (lid[:, None] == sel[None, :]).astype(dtype)  # [C, P]
             rhs = (lo[:, :, None] * st.astype(dtype)[:, None, :]
                    ).reshape(c, p * s)
-            # HIGHEST precision: TPU matmuls otherwise truncate inputs to
-            # bf16, corrupting grad/hess sums ~0.5% (the one-hot side is
-            # exact either way; counts accumulate exactly in f32 regardless)
-            h = jax.lax.dot_general(oh, rhs, (((0,), (0,)), ((), ())),
-                                    precision=jax.lax.Precision.HIGHEST,
-                                    preferred_element_type=dtype)
+            if hilo:
+                # hi/lo bf16 decomposition: the one-hot side is exact in
+                # bf16 (0/1) and the stat side is split into two bf16 parts
+                # whose matmul contributions accumulate in f32 on the MXU —
+                # 2 bf16 passes instead of the 6 that Precision.HIGHEST
+                # costs on f32 inputs. Inputs round at ~2^-17 relative
+                # (hi+lo carries ~16-17 mantissa bits vs f32's 24); sums
+                # accumulate in f32 either way. Comparable precision model
+                # to the reference GPU's float32 histograms
+                # (gpu_use_dp=false, docs/GPU-Performance.rst:133-140),
+                # with slightly coarser input rounding; counts are exact
+                # (0/1 in bf16).
+                oh = oh_bool.astype(jnp.bfloat16).reshape(c, f * num_bins)
+                rhs_hi = rhs.astype(jnp.bfloat16)
+                rhs_lo = (rhs - rhs_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+                rhs2 = jnp.concatenate([rhs_hi, rhs_lo], axis=1)
+                h2 = jax.lax.dot_general(oh, rhs2, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+                h = h2[:, :p * s] + h2[:, p * s:]
+            else:
+                oh = oh_bool.astype(dtype).reshape(c, f * num_bins)
+                # HIGHEST precision: TPU matmuls otherwise truncate inputs to
+                # bf16, corrupting grad/hess sums ~0.5% (the one-hot side is
+                # exact either way; counts accumulate exactly in f32
+                # regardless)
+                h = jax.lax.dot_general(oh, rhs, (((0,), (0,)), ((), ())),
+                                        precision=jax.lax.Precision.HIGHEST,
+                                        preferred_element_type=dtype)
             return acc + h, None
 
         h, _ = jax.lax.scan(

@@ -1,0 +1,99 @@
+"""Scratch experiments for the fused histogram kernel shape.
+
+Variants of the hi/lo bf16 kernel: features-per-dot grouping, block size.
+Not part of the library — results feed ops/pallas_hist.py tuning.
+"""
+
+import argparse
+import functools
+import time
+
+import numpy as np
+
+_PAD = 128
+
+
+def make_variant(fg, blk):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(binsT_ref, rhs_ref, out_ref, *, f, b, c):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        rhs = rhs_ref[...]
+        binsT = binsT_ref[...]
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (c, b), 1)
+        for g in range(0, f, fg):
+            k = min(fg, f - g)
+            oh = jnp.concatenate(
+                [(binsT[g + j, :].astype(jnp.int32)[:, None] == iota_b
+                  ).astype(jnp.bfloat16) for j in range(k)], axis=1)
+            acc = jax.lax.dot_general(
+                oh, rhs, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out_ref[g * b:(g + k) * b, :] += acc[:, :_PAD] + acc[:, _PAD:]
+
+    @functools.partial(jax.jit, static_argnames=("num_bins",))
+    def call(binsT, rhs, *, num_bins):
+        f, n = binsT.shape
+        nblk = n // blk
+        return pl.pallas_call(
+            functools.partial(kernel, f=f, b=num_bins, c=blk),
+            grid=(nblk,),
+            in_specs=[
+                pl.BlockSpec((f, blk), lambda i: (0, i)),
+                pl.BlockSpec((blk, 2 * _PAD), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((f * num_bins, _PAD), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((f * num_bins, _PAD), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+        )(binsT, rhs)
+
+    return call
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=2_000_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--bins", type=int, default=255)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--variants", type=str, default="2x2048,4x2048,4x1024,7x1024")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    n, f, b = args.rows, args.features, args.bins
+    rng = np.random.RandomState(0)
+    binsT = jnp.asarray(rng.randint(0, b, size=(f, n)).astype(np.uint8))
+    rhs = jnp.asarray(rng.normal(size=(n, 2 * _PAD)).astype(np.float32)
+                      ).astype(jnp.bfloat16)
+
+    for spec in args.variants.split(","):
+        fg, blk = (int(x) for x in spec.split("x"))
+        npad = -n % blk
+        binsT_p = jnp.pad(binsT, ((0, 0), (0, npad))) if npad else binsT
+        rhs_p = jnp.pad(rhs, ((0, npad), (0, 0))) if npad else rhs
+        try:
+            call = make_variant(fg, blk)
+            fn = lambda: call(binsT_p, rhs_p, num_bins=b)
+            fn()
+            _ = float(np.asarray(fn()).ravel()[0])
+            t0 = time.time()
+            for _ in range(args.reps):
+                out = fn()
+            _ = float(np.asarray(out).ravel()[0])
+            dt = (time.time() - t0) / args.reps
+            print(f"fg={fg} blk={blk}: {dt*1e3:9.1f} ms/pass")
+        except Exception as e:
+            print(f"fg={fg} blk={blk}: FAILED {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()
