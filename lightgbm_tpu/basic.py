@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -262,10 +263,27 @@ class Dataset:
             self._build_feature_meta(config)
 
         used = [self.mappers[j] for j in self.used_features]
-        Xu = X[:, self.used_features] if len(self.used_features) else np.zeros((self.num_data, 0))
-        bins_np = binning.bin_data(Xu, used)
         dtype = np.uint8 if self.max_num_bins <= 256 else np.int32
-        self.bins = jnp.asarray(bins_np.astype(dtype))
+        raw_np = raw.values if hasattr(raw, "values") else raw
+        # float32 input on a TPU backend quantizes ON DEVICE (bit-exact vs
+        # the host path, see binning.device_bin_tables): the host
+        # searchsorted loop is the construct bottleneck on small hosts
+        # (reference bins at memory speed with OpenMP, dense_bin.hpp)
+        use_device = (jax.default_backend() == "tpu"
+                      and len(self.used_features)
+                      and isinstance(raw_np, np.ndarray) and raw_np.ndim == 2
+                      and raw_np.dtype == np.float32
+                      and all(m.bin_type == binning.BIN_TYPE_NUMERICAL
+                              for m in used))
+        if use_device:
+            Xu32 = raw_np if len(used) == raw_np.shape[1] \
+                else np.ascontiguousarray(raw_np[:, self.used_features])
+            self.bins = binning.bin_data_device(Xu32, used)
+        else:
+            Xu = X[:, self.used_features] if len(self.used_features) \
+                else np.zeros((self.num_data, 0))
+            bins_np = binning.bin_data(Xu, used)
+            self.bins = jnp.asarray(bins_np.astype(dtype))
         # raw feature retention for linear trees (reference: dataset.h:720
         # raw_data_, kept when linear_tree so leaves can fit linear models)
         keep_raw = config.linear_tree or (

@@ -36,6 +36,16 @@ from .tree import (HostTree, TreeArrays, predict_leaf_bins, predict_value_bins,
                    predict_values_stacked, stack_trees)
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _bagging_mask(key: jax.Array, frac, n: int) -> jax.Array:
+    """0/1 bagging mask drawn on device (gbdt.cpp:228-262 Bagging)."""
+    u = jax.random.uniform(key, (n,))
+    return (u < frac).astype(jnp.float32)
+
+
 class GBDT:
     """Gradient Boosting Decision Tree (reference: gbdt.h:42, boosting.h:27)."""
 
@@ -119,8 +129,8 @@ class GBDT:
         self.metric_names = [nm for nm in (cfg.metric or
                                            default_metric_for_objective(cfg.objective))]
         self._metric_cache: Dict[Tuple[str, int], Metric] = {}
-        # bagging / feature-fraction rngs (seeds per config.h:282,307)
-        self._bag_rng = np.random.RandomState(cfg.bagging_seed)
+        # feature-fraction rng (seed per config.h:307); bagging/GOSS draws
+        # come from the device PRNG keyed on bagging_seed
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._bag_mask = jnp.ones((n,), dtype=jnp.float32)
         self._need_bagging = (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0) or \
@@ -299,6 +309,7 @@ class GBDT:
             self._setup_learner_features(self.train_set)
         self._need_bagging = (config.bagging_freq > 0 and config.bagging_fraction < 1.0) or \
             (config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0)
+        self._bag_frac = None   # fractions may have changed
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
         valid_set.construct()
@@ -319,21 +330,27 @@ class GBDT:
     # ---------------------------------------------------------- sampling
     def _update_bagging(self) -> None:
         """Bagging mask refresh (reference: gbdt.cpp:228-262 Bagging;
-        pos/neg bagging per config.h:268-280)."""
+        pos/neg bagging per config.h:268-280). The mask comes from the
+        device PRNG — no per-period host uniform draw + upload."""
         cfg = self.config
         if not self._need_bagging:
             return
         if cfg.bagging_freq <= 0 or self.iter % cfg.bagging_freq != 0:
             return
         n = self.train_set.num_data
-        u = self._bag_rng.uniform(size=n)
-        if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
-            pos = self.objective.label_np > 0 if hasattr(self.objective, "label_np") \
-                else self.train_set.get_label() > 0
-            frac = np.where(pos, cfg.pos_bagging_fraction, cfg.neg_bagging_fraction)
-        else:
-            frac = cfg.bagging_fraction
-        self._bag_mask = jnp.asarray((u < frac).astype(np.float32))
+        if getattr(self, "_bag_frac", None) is None:
+            if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
+                pos = self.objective.label_np > 0 \
+                    if hasattr(self.objective, "label_np") \
+                    else self.train_set.get_label() > 0
+                self._bag_frac = jnp.asarray(np.where(
+                    pos, cfg.pos_bagging_fraction,
+                    cfg.neg_bagging_fraction).astype(np.float32))
+            else:
+                self._bag_frac = jnp.float32(cfg.bagging_fraction)
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed),
+                                 self.iter)
+        self._bag_mask = _bagging_mask(key, self._bag_frac, n)
 
     def _feature_mask(self) -> jax.Array:
         """Per-tree column sampling (reference: col_sampler.hpp:20-50

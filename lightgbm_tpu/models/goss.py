@@ -18,6 +18,7 @@ grad/hess are amplified exactly like the reference.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -29,6 +30,44 @@ from ..config import Config
 from ..objectives import ObjectiveFunction
 from ..utils import log
 from .gbdt import GBDT
+
+_MAXU = jnp.uint32(0xFFFFFFFF)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
+def goss_weights(score: jax.Array, key: jax.Array, top_k: int,
+                 other_k: int) -> jax.Array:
+    """Per-row GOSS weights entirely on device (goss.hpp:105-150, without
+    the reference's host-side argsort — at 10M rows the score download +
+    single-core sort + weight upload serialized every iteration).
+
+    Exact counts: exactly ``top_k`` rows keep weight 1 (threshold = k-th
+    largest score; ties broken by random uint32 draws) and exactly
+    ``other_k`` of the rest keep the amplification weight
+    (n - top_k)/other_k (selected as the other_k smallest random draws —
+    the device analog of sampling without replacement).
+    """
+    n = score.shape[0]
+    svals = jnp.sort(score)
+    t = svals[n - top_k]                       # k-th largest value
+    strict = score > t
+    c1 = jnp.sum(strict.astype(jnp.int32))
+    tie = score == t
+    r = jax.random.bits(key, (n,), jnp.uint32)
+    # pick the (top_k - c1) ties with the smallest tie-break draws
+    rt = jnp.where(tie, r, _MAXU)
+    need = top_k - c1
+    thr_tie = jnp.sort(rt)[jnp.maximum(need - 1, 0)]
+    is_top = strict | (tie & (rt <= thr_tie) & (need > 0))
+    rest = ~is_top
+    r2 = jax.random.bits(jax.random.fold_in(key, 1), (n,), jnp.uint32)
+    rr = jnp.where(rest, r2, _MAXU)
+    kk = min(other_k, n - top_k)               # rest count is n - top_k
+    thr_other = jnp.sort(rr)[jnp.maximum(kk - 1, 0)]
+    pick = rest & (rr <= thr_other)
+    multiply = jnp.float32((n - top_k) / other_k)   # goss.hpp:119-121
+    return (is_top.astype(jnp.float32)
+            + pick.astype(jnp.float32) * multiply)
 
 
 class GOSS(GBDT):
@@ -48,27 +87,18 @@ class GOSS(GBDT):
         super().__init__(config, train_set, objective)
 
     def _sample_weights(self, g, h) -> Optional[jax.Array]:
-        """reference: goss.hpp:105-150 BaggingHelper, vectorized."""
+        """reference: goss.hpp:105-150 BaggingHelper — selection, weights
+        and RNG all stay on device (no per-iteration host round trip)."""
         cfg = self.config
         if self.iter < int(1.0 / cfg.learning_rate):
             return None
-        gnp = np.asarray(g, dtype=np.float64)
-        hnp = np.asarray(h, dtype=np.float64)
-        if gnp.ndim > 1:
-            score = np.sum(np.abs(gnp * hnp), axis=1)
+        if g.ndim > 1:
+            score = jnp.sum(jnp.abs(g * h), axis=1)
         else:
-            score = np.abs(gnp * hnp)
+            score = jnp.abs(g * h)
         n = score.shape[0]
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
-        order = np.argsort(-score, kind="stable")
-        top_idx = order[:top_k]
-        rest_idx = order[top_k:]
-        multiply = (n - top_k) / other_k
-        chosen = self._bag_rng.choice(rest_idx.shape[0],
-                                      size=min(other_k, rest_idx.shape[0]),
-                                      replace=False)
-        w = np.zeros((n,), dtype=np.float32)
-        w[top_idx] = 1.0
-        w[rest_idx[chosen]] = multiply
-        return jnp.asarray(w)
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed),
+                                 self.iter)
+        return goss_weights(score, key, top_k, other_k)

@@ -200,11 +200,10 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
                 # (gpu_use_dp=false, docs/GPU-Performance.rst:133-140),
                 # with slightly coarser input rounding; counts are exact
                 # (0/1 in bf16).
+                from .pallas_hist import split_hilo
                 oh = oh_bool.astype(jnp.bfloat16).reshape(c, f * num_bins)
-                rhs_hi = rhs.astype(jnp.bfloat16)
-                rhs_lo = (rhs - rhs_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-                rhs2 = jnp.concatenate([rhs_hi, rhs_lo], axis=1)
-                h2 = jax.lax.dot_general(oh, rhs2, (((0,), (0,)), ((), ())),
+                h2 = jax.lax.dot_general(oh, split_hilo(rhs),
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
                 h = h2[:, :p * s] + h2[:, p * s:]
             else:

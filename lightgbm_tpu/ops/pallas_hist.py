@@ -11,11 +11,22 @@ Why a kernel at all: the XLA formulation (histogram.py "onehot") must
 materialize the ``[C, F*B]`` one-hot in HBM — ~300 GB of traffic per full
 pass at Higgs scale, which bounds the pass at ~370-450 ms. Fused, the
 one-hot never leaves VMEM and the pass is bounded by the bin-compare VPU
-work (~75 G ops) plus the f32 matmuls.
+work (~75 G ops) plus the matmuls.
 
-The leaf-channel RHS ``[N, PAD]`` (leaf one-hot x stats, PS columns padded
-to the 128-lane boundary) is prepared by XLA — it is small (~2% of the
-one-hot's traffic).
+Two precision modes share one kernel body (``hilo`` flag):
+
+- hilo=True (the fast default): the rhs carries [hi || lo] bf16 halves of
+  the f32 channels; both halves' products accumulate in f32 on the MXU, so
+  the recombined sum carries ~16-17 mantissa bits of input precision
+  (~2^-17 relative rounding) with exact counts — comparable to (slightly
+  coarser than) the reference GPU's float32 histograms (gpu_use_dp=false,
+  docs/GPU-Performance.rst:133-140), at 2 bf16 MXU passes.
+- hilo=False: f32 rhs contracted at Precision.HIGHEST (6 bf16 passes) —
+  the precise alternative.
+
+The leaf-channel RHS (leaf one-hot x stats, P*S columns padded to the
+128-lane boundary) is prepared by XLA — it is small (~2% of the one-hot's
+traffic).
 """
 
 from __future__ import annotations
@@ -28,137 +39,55 @@ import jax.numpy as jnp
 _PAD = 128          # lane width; P*S channels are padded up to this
 
 
-def _hist_kernel(binsT_ref, rhs_ref, out_ref, *, f, b, c):
+def _hist_kernel(binsT_ref, rhs_ref, out_ref, *, f, b, c, hilo):
     from jax.experimental import pallas as pl
 
     @pl.when(pl.program_id(0) == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    rhs = rhs_ref[...]                                   # [C, PAD] f32
+    rhs = rhs_ref[...]                    # [C, 2*PAD] bf16 or [C, PAD] f32
     binsT = binsT_ref[...]                               # [F, C] int8
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (c, b), 1)
+    oh_dtype = jnp.bfloat16 if hilo else jnp.float32
+    prec = None if hilo else jax.lax.Precision.HIGHEST
     for j in range(f):                                   # static unroll
         col = binsT[j, :].astype(jnp.int32)              # [C]
-        oh = (col[:, None] == iota_b).astype(jnp.float32)   # [C, B] in VMEM
+        oh = (col[:, None] == iota_b).astype(oh_dtype)   # [C, B] in VMEM
         acc = jax.lax.dot_general(
-            oh, rhs, (((0,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)          # [B, PAD]
+            oh, rhs, (((0,), (0,)), ((), ())), precision=prec,
+            preferred_element_type=jnp.float32)
+        if hilo:
+            acc = acc[:, :_PAD] + acc[:, _PAD:]          # recombine halves
         out_ref[j * b:(j + 1) * b, :] += acc
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("num_bins", "block"))
-def _hist_pallas_call(binsT, rhs, *, num_bins, block):
+@functools.partial(jax.jit, static_argnames=("num_bins", "block", "hilo"))
+def _hist_pallas_call(binsT, rhs, *, num_bins, block, hilo):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     f, n = binsT.shape
     c = block
     nblk = n // c
-    kernel = functools.partial(_hist_kernel, f=f, b=num_bins, c=c)
+    w = 2 * _PAD if hilo else _PAD
+    kernel = functools.partial(_hist_kernel, f=f, b=num_bins, c=c, hilo=hilo)
     return pl.pallas_call(
         kernel,
         grid=(nblk,),
         in_specs=[
             pl.BlockSpec((f, c), lambda i: (0, i)),
-            pl.BlockSpec((c, _PAD), lambda i: (i, 0)),
+            pl.BlockSpec((c, w), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((f * num_bins, _PAD), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((f * num_bins, _PAD), jnp.float32),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )(binsT, rhs)
-
-
-def _hist_kernel_hilo(binsT_ref, rhs_ref, out_ref, *, f, b, c):
-    """hi/lo bf16 variant: rhs carries [hi || lo] bf16 halves whose products
-    accumulate in f32 on the MXU — 2 bf16 passes instead of the 6 that
-    Precision.HIGHEST costs on f32 inputs, at ~2^-17 relative input
-    rounding (~16-17 mantissa bits)."""
-    from jax.experimental import pallas as pl
-
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    rhs = rhs_ref[...]                                   # [C, 2*PAD] bf16
-    binsT = binsT_ref[...]                               # [F, C] int8
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (c, b), 1)
-    for j in range(f):                                   # static unroll
-        col = binsT[j, :].astype(jnp.int32)              # [C]
-        oh = (col[:, None] == iota_b).astype(jnp.bfloat16)  # [C, B] in VMEM
-        acc = jax.lax.dot_general(
-            oh, rhs, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [B, 2*PAD]
-        out_ref[j * b:(j + 1) * b, :] += acc[:, :_PAD] + acc[:, _PAD:]
-
-
-@functools.partial(jax.jit, static_argnames=("num_bins", "block"))
-def _hist_pallas_call_hilo(binsT, rhs, *, num_bins, block):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    f, n = binsT.shape
-    c = block
-    nblk = n // c
-    kernel = functools.partial(_hist_kernel_hilo, f=f, b=num_bins, c=c)
-    return pl.pallas_call(
-        kernel,
-        grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((f, c), lambda i: (0, i)),
-            pl.BlockSpec((c, 2 * _PAD), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((f * num_bins, _PAD), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f * num_bins, _PAD), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
-    )(binsT, rhs)
-
-
-def histogram_tiles_pallas_hilo(binsT: jax.Array, stats: jax.Array,
-                                leaf_ids: jax.Array, sel: jax.Array,
-                                num_bins: int, block: int = 2048) -> jax.Array:
-    """[P, F, B, S] histogram tile via the fused kernel, hi/lo bf16 matmuls.
-
-    Numerically: each bf16 product against the exact 0/1 one-hot is the bf16
-    input value itself, accumulated in f32; the recombined hi+lo sum carries
-    ~16-17 mantissa bits of input precision (~2^-17 relative rounding) with
-    exact counts — the fast-path precision model, comparable to (slightly
-    coarser than) the reference GPU's float32 histograms (gpu_use_dp=false).
-    The HIGHEST-precision kernel below is the precise alternative.
-    """
-    f, n = binsT.shape
-    p = sel.shape[0]
-    s = stats.shape[1]
-    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block)
-    rhs_hi = rhs.astype(jnp.bfloat16)
-    rhs_lo = (rhs - rhs_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    rhs2 = jnp.concatenate([rhs_hi, rhs_lo], axis=1)     # [N, 2*PAD]
-    out = _hist_pallas_call_hilo(binsT, rhs2, num_bins=num_bins, block=c)
-    return out[:, :p * s].reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
-
-
-def histogram_tiles_pallas(binsT: jax.Array, stats: jax.Array,
-                           leaf_ids: jax.Array, sel: jax.Array,
-                           num_bins: int, block: int = 2048) -> jax.Array:
-    """[P, F, B, S] histogram tile via the fused kernel.
-
-    Args mirror histogram.py histogram_tiles but take the FEATURE-MAJOR bin
-    matrix [F, N] (contiguous per-feature rows for the kernel's block
-    loads).
-    """
-    f, n = binsT.shape
-    p = sel.shape[0]
-    s = stats.shape[1]
-    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block)
-    out = _hist_pallas_call(binsT, rhs, num_bins=num_bins, block=c)
-    return out[:, :p * s].reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
 
 
 def _prep_rhs(binsT, stats, leaf_ids, sel, block):
-    """Shared prep for both kernels: pad rows to the block size and build
-    the f32 leaf-onehot x stat channel matrix [N, _PAD]."""
+    """Shared prep: pad rows to the block size and build the f32
+    leaf-onehot x stat channel matrix [N, _PAD]."""
     f, n = binsT.shape
     p = sel.shape[0]
     s = stats.shape[1]
@@ -174,3 +103,43 @@ def _prep_rhs(binsT, stats, leaf_ids, sel, block):
            ).reshape(-1, p * s)
     rhs = jnp.pad(rhs, ((0, 0), (0, _PAD - p * s)))
     return binsT, rhs, c
+
+
+def split_hilo(rhs: jax.Array) -> jax.Array:
+    """f32 [N, W] -> [hi || lo] bf16 [N, 2W]: the two halves' exact-product
+    contributions recombine to ~16-17 mantissa bits of input precision."""
+    rhs_hi = rhs.astype(jnp.bfloat16)
+    rhs_lo = (rhs - rhs_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return jnp.concatenate([rhs_hi, rhs_lo], axis=1)
+
+
+def histogram_tiles_pallas(binsT: jax.Array, stats: jax.Array,
+                           leaf_ids: jax.Array, sel: jax.Array,
+                           num_bins: int, block: int = 2048) -> jax.Array:
+    """[P, F, B, S] histogram tile via the fused kernel, HIGHEST precision.
+
+    Args mirror histogram.py histogram_tiles but take the FEATURE-MAJOR bin
+    matrix [F, N] (contiguous per-feature rows for the kernel's block
+    loads).
+    """
+    f, n = binsT.shape
+    p = sel.shape[0]
+    s = stats.shape[1]
+    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block)
+    out = _hist_pallas_call(binsT, rhs, num_bins=num_bins, block=c,
+                            hilo=False)
+    return out[:, :p * s].reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
+
+
+def histogram_tiles_pallas_hilo(binsT: jax.Array, stats: jax.Array,
+                                leaf_ids: jax.Array, sel: jax.Array,
+                                num_bins: int, block: int = 2048) -> jax.Array:
+    """[P, F, B, S] histogram tile via the fused kernel, hi/lo bf16 matmuls
+    (the fast default — see the module docstring's precision model)."""
+    f, n = binsT.shape
+    p = sel.shape[0]
+    s = stats.shape[1]
+    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block)
+    out = _hist_pallas_call(binsT, split_hilo(rhs), num_bins=num_bins,
+                            block=c, hilo=True)
+    return out[:, :p * s].reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
