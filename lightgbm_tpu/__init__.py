@@ -8,6 +8,7 @@ training over device meshes, and a Python API mirroring the reference's
 python-package surface (Dataset/Booster/train/cv/sklearn wrappers).
 """
 
+from . import distributed
 from .basic import Dataset
 from .booster import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,

@@ -37,6 +37,11 @@ class Booster:
         elif train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise TypeError("Training data should be Dataset instance")
+            # num_machines > 1: bootstrap jax.distributed before any device
+            # work (the reference calls Network::Init before training,
+            # application.cpp:167-178)
+            from . import distributed
+            distributed.maybe_init_from_config(self.config)
             # merge dataset params before construction
             merged = dict(train_set.params or {})
             merged.update(self.params)

@@ -1,0 +1,163 @@
+"""Multi-host bootstrap: the analog of the reference's distributed init.
+
+The reference bootstraps its socket/MPI mesh from ``machines`` +
+``local_listen_port`` + ``num_machines`` (reference:
+src/network/linkers_socket.cpp:24-63 parse machine list, identify own rank
+by local-IP match :38, bind + full-mesh handshake;
+src/application/application.cpp:167-178 CLI init; Dask injects the same
+params per worker, python-package/lightgbm/dask.py:211-330).
+
+On TPU the entire linker layer collapses into ``jax.distributed.initialize``:
+after it, every process sees the GLOBAL device set, `jax.devices()` spans
+all hosts, and the same shard_map programs the single-host learners run
+scale over ICI/DCN with zero further changes — collectives are compiled
+into the program, so there is no rank-tagged socket protocol to speak.
+
+Usage (one call per process, before constructing any Booster):
+
+    import lightgbm_tpu as lgb
+    lgb.distributed.init()                       # env-based (TPU pods)
+    # or explicitly, the reference's machine-list style:
+    lgb.distributed.init(machines="10.0.0.1:12400,10.0.0.2:12400")
+    # or from a config/params dict holding machines/num_machines:
+    lgb.distributed.init(params={"machines": "...", "num_machines": 2})
+
+Rank resolution mirrors linkers_socket.cpp:38: if ``process_id`` is not
+given, the local host's addresses are matched against the machine list.
+On managed TPU pods (GKE/Cloud TPU), call ``init()`` with no arguments —
+JAX's cluster autodetection fills coordinator/rank from the environment.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+from .utils import log
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def _local_addresses() -> set:
+    addrs = {"127.0.0.1", "localhost", "0.0.0.0"}
+    try:
+        hostname = socket.gethostname()
+        addrs.add(hostname)
+        for info in socket.getaddrinfo(hostname, None):
+            addrs.add(info[4][0])
+    except OSError:
+        pass
+    return addrs
+
+
+def _rank_from_machines(machines: list,
+                        listen_port: Optional[int] = None) -> Optional[int]:
+    """Identify this process's rank by local-IP match (the reference's
+    protocol, linkers_socket.cpp:38). With several processes on one host,
+    ``listen_port`` (the reference's local_listen_port) disambiguates by
+    exact host:port match; an ambiguous match without it is fatal rather
+    than silently rank 0."""
+    local = _local_addresses()
+    matches = [i for i, m in enumerate(machines)
+               if m.rsplit(":", 1)[0] in local]
+    if listen_port is not None:
+        exact = [i for i in matches
+                 if machines[i].rsplit(":", 1)[-1] == str(listen_port)]
+        if len(exact) == 1:
+            return exact[0]
+    if len(matches) > 1:
+        log.fatal(f"multiple machines entries match this host "
+                  f"({[machines[i] for i in matches]}); set "
+                  f"local_listen_port or process_id to disambiguate")
+    return matches[0] if matches else None
+
+
+def init(machines: Optional[str] = None,
+         num_machines: Optional[int] = None,
+         process_id: Optional[int] = None,
+         coordinator_address: Optional[str] = None,
+         params: Optional[dict] = None,
+         local_device_ids=None) -> None:
+    """Initialize multi-host training (idempotent).
+
+    Args:
+      machines: comma-separated "host:port,host:port,..." — the reference's
+        ``machines`` parameter (config.h:989). The FIRST entry is the
+        coordinator.
+      num_machines: process count; defaults to len(machines).
+      process_id: this process's rank; default: local-IP match against the
+        machine list (linkers_socket.cpp:38) or the JAX env autodetection.
+      coordinator_address: overrides the coordinator (host:port).
+      params: a params/config mapping — ``machines``/``num_machines``/
+        ``local_listen_port`` are read from it when the explicit args are
+        absent (so CLI configs written for the reference work unchanged).
+      local_device_ids: forwarded to ``jax.distributed.initialize``.
+    """
+    global _initialized
+    if _initialized:
+        log.warning("distributed.init called twice; ignoring")
+        return
+    import jax
+
+    listen_port = None
+    if params:
+        get = params.get if hasattr(params, "get") else \
+            lambda k, d=None: getattr(params, k, d)
+        machines = machines or get("machines") or None
+        num_machines = num_machines or int(get("num_machines") or 0) or None
+        lp = get("local_listen_port")
+        listen_port = int(lp) if lp else None
+
+    mlist = [m.strip() for m in machines.split(",") if m.strip()] \
+        if machines else []
+    if mlist:
+        if num_machines is None:
+            num_machines = len(mlist)
+        if coordinator_address is None:
+            coordinator_address = mlist[0]
+        if process_id is None:
+            process_id = _rank_from_machines(mlist, listen_port)
+            if process_id is None:
+                log.fatal(f"none of this host's addresses match the "
+                          f"machines list {mlist} (set process_id "
+                          f"explicitly)")
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_machines is not None:
+        kwargs["num_processes"] = num_machines
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    log.info(f"distributed: process {jax.process_index()} of "
+             f"{jax.process_count()}, {len(jax.devices())} global devices")
+
+
+def shutdown() -> None:
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+    jax.distributed.shutdown()
+    _initialized = False
+
+
+def maybe_init_from_config(config) -> None:
+    """Auto-init when a Booster is constructed with num_machines > 1 and
+    distributed training was not explicitly initialized (the CLI flow,
+    application.cpp:167-178: Network::Init happens before training)."""
+    if _initialized:
+        return
+    nm = int(getattr(config, "num_machines", 1) or 1)
+    if nm > 1:
+        init(machines=getattr(config, "machines", None) or None,
+             num_machines=nm)

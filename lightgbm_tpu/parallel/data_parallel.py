@@ -25,16 +25,15 @@ replicated (identical on every device), the leaf ids stay row-sharded.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..ops.split import FeatureMeta, SplitParams
-from ..models.grower import grow_tree
 from ..models.tree import TreeArrays
+
+_dp_growers = {}   # (mesh, axis) -> ParallelGrower (compile-cache reuse)
 
 
 def make_mesh(num_devices: Optional[int] = None, axis: str = "data") -> Mesh:
@@ -57,32 +56,22 @@ def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
                  axis: str = "data") -> Tuple[TreeArrays, jax.Array]:
     """Grow one tree with rows sharded over ``mesh`` axis ``axis``.
 
-    Inputs may be host arrays; they are sharded on entry. Returns the
-    (replicated) tree and the row-sharded leaf ids.
+    Thin mesh-explicit alias over the PRODUCTION data-parallel learner
+    (learners.ParallelGrower mode="data": histogram psum_scatter over
+    feature ownership + owner search + best-split sync — the reference's
+    ReduceScatter pattern, data_parallel_tree_learner.cpp:184-186). Kept so
+    callers holding an explicit Mesh (the driver dry run, unit tests) hit
+    the same program the ``tree_learner="data"`` public API runs.
     """
-    n = bins.shape[0]
-    ndev = mesh.shape[axis]
-    if n % ndev != 0:
-        # pad rows to a multiple of the mesh size with zero-mass rows
-        pad = ndev - n % ndev
-        bins = jnp.concatenate([bins, jnp.zeros((pad, bins.shape[1]), bins.dtype)])
-        grad = jnp.concatenate([grad, jnp.zeros((pad,), grad.dtype)])
-        hess = jnp.concatenate([hess, jnp.zeros((pad,), hess.dtype)])
-        sample_mask = jnp.concatenate([sample_mask, jnp.zeros((pad,), sample_mask.dtype)])
-
     from ..ops.histogram import resolve_method
-    grow = functools.partial(
-        grow_tree, max_leaves=max_leaves, num_bins=num_bins,
+    from .learners import ParallelGrower
+    pg = _dp_growers.get((mesh, axis))
+    if pg is None:
+        pg = ParallelGrower("data", mesh=mesh, axis=axis)
+        _dp_growers[(mesh, axis)] = pg
+    tree, leaf_id, _aux = pg(
+        bins, grad, hess, sample_mask, meta, params, feature_mask,
+        missing_bin, max_leaves=max_leaves, num_bins=num_bins,
         max_depth=max_depth, hist_method=resolve_method(hist_method),
-        exact=exact, with_categorical=with_categorical, axis_name=axis)
-
-    from ..models.grower import GrowAux
-    shard = jax.shard_map(
-        grow, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis),
-                  P(), P(), P(), P()),
-        out_specs=(P(), P(axis), GrowAux(P(), P())),
-        check_vma=False)
-    tree, leaf_id, _aux = shard(bins, grad, hess, sample_mask, meta, params,
-                                feature_mask, missing_bin)
-    return tree, leaf_id[:n]
+        exact=exact, with_categorical=with_categorical)
+    return tree, leaf_id

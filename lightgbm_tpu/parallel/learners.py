@@ -77,6 +77,7 @@ class ParallelGrower:
         self.mesh = mesh if mesh is not None else make_mesh(axis=axis)
         self.ndev = self.mesh.shape[axis]
         self._cache = {}
+        self._global_arrays = {}   # id(host arr) -> (host arr, global arr)
 
     def _build(self, has_binsT: bool, grow_kwargs: tuple):
         axis = self.axis
@@ -93,29 +94,68 @@ class ParallelGrower:
         row = P(axis) if rows_sharded else P()
         row2 = P(axis, None) if rows_sharded else P()
         colT = P(None, axis) if rows_sharded else P()
+        # multi-controller: replicate the leaf ids with an in-program
+        # all_gather so every process can address the full vector for its
+        # (replicated-data) score update — the per-machine score partition
+        # of the reference (score_updater.hpp) is a later optimization
+        multiproc = jax.process_count() > 1
+        gather_leaf = multiproc and rows_sharded
 
+        def run(bins, grad, hess, mask, meta, params, fmask, missing_bin,
+                binsT, rng_key):
+            tree, leaf_id, aux = grow_tree(
+                bins, grad, hess, mask, meta, params, fmask,
+                missing_bin, binsT=binsT, rng_key=rng_key, **kw)
+            if gather_leaf:
+                leaf_id = jax.lax.all_gather(leaf_id, axis, tiled=True)
+            return tree, leaf_id, aux
+
+        leaf_spec = P() if gather_leaf else row
         if has_binsT:
-            def fn(bins, grad, hess, mask, meta, params, fmask, missing_bin,
-                   binsT, rng_key):
-                return grow_tree(bins, grad, hess, mask, meta, params, fmask,
-                                 missing_bin, binsT=binsT, rng_key=rng_key,
-                                 **kw)
+            fn = run
             in_specs = (row2, row, row, row, P(), P(), P(), P(), colT, P())
         else:
             def fn(bins, grad, hess, mask, meta, params, fmask, missing_bin,
                    rng_key):
-                return grow_tree(bins, grad, hess, mask, meta, params, fmask,
-                                 missing_bin, rng_key=rng_key, **kw)
+                return run(bins, grad, hess, mask, meta, params, fmask,
+                           missing_bin, None, rng_key)
             in_specs = (row2, row, row, row, P(), P(), P(), P(), P())
-        out_specs = (P(), row, GrowAux(P(), P()))
+        out_specs = (P(), leaf_spec, GrowAux(P(), P()))
         return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
+
+    def _to_global(self, arr, spec, key=None):
+        """Multi-controller: build a GLOBAL array from this process's full
+        host copy (every process constructed the same Dataset — the
+        reference's machine-list flow where each machine loads data and the
+        learner operates on its row shard). Each process materializes only
+        its addressable shards. ``key`` (the pre-padding original of a
+        dataset-constant input) caches the globalization so bins/meta/masks
+        globalize once, not once per tree."""
+        if arr is None or jax.process_count() == 1:
+            return arr
+        if key is not None:
+            hit = self._global_arrays.get(id(key))
+            if hit is not None and hit[0] is key:
+                return hit[1]
+        host = np.asarray(arr)
+        sharding = jax.sharding.NamedSharding(self.mesh, spec)
+        out = jax.make_array_from_callback(host.shape, sharding,
+                                           lambda idx: host[idx])
+        if key is not None:
+            # keep the source alive so id() stays unique
+            self._global_arrays[id(key)] = (key, out)
+        return out
 
     def __call__(self, bins, grad, hess, sample_mask, meta, params,
                  feature_mask, missing_bin, *, binsT=None, rng_key=None,
                  **grow_kwargs):
         n, f = bins.shape
         d = self.ndev
+        # pre-padding originals key the multi-process globalization cache
+        # (padding allocates fresh arrays every call)
+        orig_bins, orig_binsT = bins, binsT
+        orig_meta, orig_missing_bin = meta, missing_bin
         # pad rows (data/voting shard rows) and features (data/feature
         # shard feature ownership) to multiples of the mesh size
         n_pad = (-n) % d if self.mode in ("data", "voting") else 0
@@ -135,6 +175,22 @@ class ParallelGrower:
                 binsT = jnp.pad(binsT, ((0, f_pad), (0, 0)))
         if rng_key is None:
             rng_key = jax.random.PRNGKey(0)
+        if jax.process_count() > 1:
+            axis = self.axis
+            rows_sharded = self.mode in ("data", "voting")
+            row = P(axis) if rows_sharded else P()
+            row2 = P(axis, None) if rows_sharded else P()
+            bins = self._to_global(bins, row2, key=orig_bins)
+            grad = self._to_global(grad, row)
+            hess = self._to_global(hess, row)
+            sample_mask = self._to_global(sample_mask, row)
+            binsT = self._to_global(binsT, P(None, axis) if rows_sharded
+                                    else P(), key=orig_binsT)
+            meta = type(meta)(*(self._to_global(a, P(), key=ka)
+                                for a, ka in zip(meta, orig_meta)))
+            feature_mask = self._to_global(feature_mask, P())
+            missing_bin = self._to_global(missing_bin, P(),
+                                          key=orig_missing_bin)
 
         key = (binsT is not None, tuple(sorted(grow_kwargs.items())))
         shard = self._cache.get(key)

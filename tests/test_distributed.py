@@ -163,3 +163,67 @@ def test_tree_learner_public_api_matches_serial(mode):
     if mode == "voting":
         extra["top_k"] = 4   # 2*top_k == F: full electorate
     np.testing.assert_allclose(fit({}), fit(extra), rtol=1e-4, atol=1e-6)
+
+
+def test_voting_election_confines_splits(mesh8):
+    """Discriminative PV-tree election check (voting_parallel_tree_learner
+    .cpp:151-182 GlobalVoting): a feature with the highest GLOBAL gain but
+    support on only one shard (1 vote) must lose the election to features
+    that win votes across shards — the root split must come from the
+    elected set, while serial growth picks the unelected global-best."""
+    from lightgbm_tpu.parallel.learners import ParallelGrower
+    rng = np.random.RandomState(11)
+    n, f, b = 512, 6, 16
+    shard_rows = n // 8
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    grad = 0.05 * rng.normal(size=n).astype(np.float32)
+    hess = np.ones(n, dtype=np.float32)
+    # f0: moderate signal on EVERY shard (wins most shards' top-1 vote)
+    grad += 0.5 * np.where(bins[:, 0] < b // 2, -1.0, 1.0).astype(np.float32)
+    # f1: strong signal only on shard 0 (1 vote)
+    s0 = slice(0, shard_rows)
+    grad[s0] += 2.0 * np.where(bins[s0, 1] < b // 2, -1.0, 1.0)
+    # f5: HUGE signal only on shard 1 -> highest global gain, but 1 vote and
+    # the highest feature index (loses the tie-break to f1)
+    s1 = slice(shard_rows, 2 * shard_rows)
+    grad[s1] += 20.0 * np.where(bins[s1, 5] < b // 2, -1.0, 1.0)
+
+    meta, missing_bin = _make_meta([b] * f)
+    params = _make_params(min_data=5)
+    args = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones((n,), jnp.float32), meta, params,
+            jnp.ones((f,), jnp.float32), jnp.asarray(missing_bin))
+    tree_s, _, _aux = grow_tree(*args, max_leaves=2, num_bins=b)
+    assert int(np.asarray(tree_s.node_feature)[0]) == 5  # serial: global best
+    pg = ParallelGrower("voting", mesh8, axis="data")
+    tree_v, _, _aux2 = pg(*args, max_leaves=2, num_bins=b, vote_top_k=1)
+    root_feat = int(np.asarray(tree_v.node_feature)[0])
+    # electorate = top-2 by votes: f0 (6 votes) + f1 (tie-break by index)
+    assert root_feat in (0, 1), root_feat
+
+
+def test_voting_quality_near_serial():
+    """PV-tree quality claim (voting_parallel_tree_learner.cpp): a
+    RESTRICTED electorate (2*top_k < F) still trains nearly as well as
+    serial when the informative features win votes."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(13)
+    n, f = 2000, 10
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.7 * X[:, 1] + 0.15 * rng.normal(size=n) > 0).astype(
+        np.float64)
+
+    def fit(extra):
+        ds = lgb.Dataset(X, label=y, params={"min_data_in_leaf": 5,
+                                             "verbosity": -1})
+        booster = lgb.train({"objective": "binary", "num_leaves": 15,
+                             "min_data_in_leaf": 5, "verbosity": -1, **extra},
+                            ds, num_boost_round=10)
+        p = booster.predict(X)
+        return float(np.mean((p > 0.5) == (y > 0.5)))
+
+    acc_serial = fit({})
+    acc_voting = fit({"tree_learner": "voting", "top_k": 2})  # electorate 4 < 10
+    assert acc_voting >= acc_serial - 0.02, (acc_serial, acc_voting)

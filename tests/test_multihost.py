@@ -1,0 +1,137 @@
+"""Multi-host bootstrap tests: 2 processes x 4 virtual CPU devices each,
+connected by ``lightgbm_tpu.distributed.init`` (jax.distributed over
+localhost gRPC), must grow the SAME tree as 1 process x 8 devices — the
+in-process analog of the reference's two-machine socket test setup
+(examples/parallel_learning/, dask.py LocalCluster tests)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_CHILD = """
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel.learners import ParallelGrower
+from lightgbm_tpu.ops.split import FeatureMeta, SplitParams
+import jax.numpy as jnp
+
+port, rank, nproc = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+machines = ",".join(f"127.0.0.1:{port}" for _ in range(nproc))
+lgb.distributed.init(machines=machines, num_machines=nproc, process_id=rank)
+assert jax.process_count() == nproc
+assert len(jax.devices()) == 8, len(jax.devices())
+
+rng = np.random.RandomState(21)
+n, f, b = 512, 6, 16
+bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+grad = rng.normal(size=n).astype(np.float32)
+hess = np.ones(n, dtype=np.float32)
+meta = FeatureMeta(
+    num_bins=jnp.full((f,), b, jnp.int32),
+    missing_type=jnp.zeros((f,), jnp.int32),
+    default_bin=jnp.zeros((f,), jnp.int32),
+    is_categorical=jnp.zeros((f,), bool),
+    monotone=jnp.zeros((f,), jnp.int8),
+    penalty=jnp.ones((f,), jnp.float32))
+params = SplitParams.from_config(lgb.Config.from_params(
+    {"min_data_in_leaf": 5}))
+pg = ParallelGrower("data")
+tree, leaf_id, _aux = pg(
+    bins, grad, hess, np.ones((n,), np.float32), meta, params,
+    np.ones((f,), np.float32), np.full((f,), -1, np.int32),
+    max_leaves=8, num_bins=b, hist_method="scatter")
+out = {
+    "rank": rank,
+    "num_leaves": int(tree.num_leaves),
+    "features": np.asarray(tree.node_feature).tolist(),
+    "thresholds": np.asarray(tree.node_threshold_bin).tolist(),
+    "leaf_values": np.asarray(tree.leaf_value).tolist(),
+}
+
+# full Booster flow: multiple rounds exercise the score update + next-round
+# gradients over the replicated leaf ids (every process runs the same SPMD
+# program on the same full-host data)
+rng2 = np.random.RandomState(5)
+Xb = rng2.normal(size=(400, 5))
+yb = (Xb[:, 0] + 0.5 * Xb[:, 1] > 0).astype(np.float64)
+booster = lgb.train({"objective": "binary", "num_leaves": 8,
+                     "tree_learner": "data", "min_data_in_leaf": 5,
+                     "verbosity": -1},
+                    lgb.Dataset(Xb, label=yb, params={"verbosity": -1}),
+                    num_boost_round=3)
+out["booster_pred"] = booster.predict(Xb[:16], raw_score=True).tolist()
+print("RESULT " + json.dumps(out))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_procs(nproc, devices_per_proc, timeout=420):
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    flags = [t for t in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in t]
+    flags.append(
+        f"--xla_force_host_platform_device_count={devices_per_proc}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(port), str(r), str(nproc)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for r in range(nproc)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    results = []
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert line, out[-3000:]
+        results.append(json.loads(line[-1][len("RESULT "):]))
+    return results
+
+
+def test_two_process_parity_with_single_process():
+    r2 = _run_procs(2, 4)          # 2 hosts x 4 devices = global mesh of 8
+    r1 = _run_procs(1, 8)          # 1 host  x 8 devices
+    assert r2[0]["num_leaves"] == r1[0]["num_leaves"]
+    assert r2[0]["features"] == r1[0]["features"]
+    assert r2[0]["thresholds"] == r1[0]["thresholds"]
+    np.testing.assert_allclose(r2[0]["leaf_values"], r1[0]["leaf_values"],
+                               rtol=1e-5, atol=1e-7)
+    # both ranks computed the identical replicated tree
+    assert r2[0]["features"] == r2[1]["features"]
+    # end-to-end Booster training (3 rounds) matches across process counts
+    np.testing.assert_allclose(r2[0]["booster_pred"], r1[0]["booster_pred"],
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(r2[0]["booster_pred"], r2[1]["booster_pred"],
+                               rtol=1e-6)
+
+
+def test_rank_from_machines_matches_local_ip():
+    from lightgbm_tpu.distributed import _rank_from_machines
+    assert _rank_from_machines(["10.255.1.2:1", "127.0.0.1:2"]) == 1
+    assert _rank_from_machines(["10.255.1.2:1", "10.255.1.3:2"]) is None
