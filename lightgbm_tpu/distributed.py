@@ -159,5 +159,6 @@ def maybe_init_from_config(config) -> None:
         return
     nm = int(getattr(config, "num_machines", 1) or 1)
     if nm > 1:
-        init(machines=getattr(config, "machines", None) or None,
-             num_machines=nm)
+        # params=config also carries local_listen_port for same-host rank
+        # disambiguation
+        init(num_machines=nm, params=config)

@@ -275,16 +275,13 @@ class GBDT:
             unsupported.append("interaction_constraints")
         if self._use_bynode:
             unsupported.append("feature_fraction_bynode")
-        if cfg.extra_trees:
-            unsupported.append("extra_trees")
         if cfg.linear_tree:
             unsupported.append("linear_tree")
-        if mode == "voting" and self.train_set.has_categorical:
-            unsupported.append("categorical features (voting)")
-        if self.train_set.bundle_meta is not None:
-            unsupported.append("EFB-bundled datasets")
-        if getattr(self, "_forced_splits", None) is not None:
-            unsupported.append("forced splits")
+        if mode == "voting" and \
+                getattr(self, "_forced_splits", None) is not None:
+            # voting keeps histograms local; a forced threshold's sums
+            # would come from one shard only
+            unsupported.append("forced splits (voting)")
         if unsupported:
             log.fatal(f"tree_learner={mode} does not support: "
                       f"{', '.join(unsupported)}")
@@ -442,11 +439,14 @@ class GBDT:
                 ts.feature_meta, self.split_params, fmask, ts.missing_bin,
                 binsT=ts.bins_T if hm.startswith(("onehot", "pallas")) else None,
                 rng_key=iter_key,
+                bundle_meta=ts.bundle_meta,
+                forced_splits=self._forced_splits,
                 max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
                 max_depth=cfg.max_depth, hist_method=hm,
                 exact=cfg.tree_growth_mode == "exact",
                 with_categorical=ts.has_categorical,
                 with_monotone=self._with_monotone,
+                extra_trees=cfg.extra_trees,
                 vote_top_k=cfg.top_k, hist_dp=self._hist_dp)
         return grow_tree(
             ts.bins, gc, hc, mask,

@@ -344,14 +344,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # --- feature-ownership slicing (FP learner, and DP's reduce-scatter)
     fp_mode = feature_axis_name is not None
     dp_scatter = fp_mode and (feature_axis_name == axis_name)
-    if bundle_meta is not None:
-        assert not fp_mode and not voting, (
-            "EFB bundles are not supported with distributed tree learners yet")
     if voting:
         assert axis_name is not None, "voting requires row sharding"
         assert not fp_mode, "voting and feature slicing are exclusive"
-        assert not with_categorical, (
-            "voting-parallel does not support categorical features")
     if fp_mode:
         assert f % feature_shards == 0, (
             f"features {f} not divisible into {feature_shards} shards "
@@ -381,6 +376,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             return arr
         return jax.lax.dynamic_slice_in_dim(arr, off, f_loc, arr.ndim - 1)
 
+    # EFB bundle structure is per-feature on the LEADING axis; owner shards
+    # search their own bundle columns (the reference's distributed learners
+    # operate on the same bundled Dataset object on every machine)
+    bundle_s = bundle_meta
+    if fp_mode and bundle_meta is not None:
+        bundle_s = type(bundle_meta)(
+            *(jax.lax.dynamic_slice_in_dim(a, off, f_loc, 0)
+              for a in bundle_meta))
+
     # hist_dp: float64 histogram accumulation, the reference CPU precision
     # model (hist_t, bin.h:32) / the gpu_use_dp flag's double mode; needs
     # jax x64 (the caller warns otherwise)
@@ -407,7 +411,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             jnp.zeros((L,), jnp.int32), meta_s, params,
             jnp.zeros((f_loc,), jnp.float32),
             max_depth, with_categorical=False, cat_words=cat_words,
-            bundle=bundle_meta)
+            bundle=bundle_s)
         if cegb_state is not None:
             used_split = cegb_state.used_split
             row_used = cegb_state.row_used
@@ -596,8 +600,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             _, fgain = find_best_splits(
                 state.hist, lsum[:, 0], lsum[:, 1], lsum[:, 2],
                 state.leaf_output, state.leaf_depth, meta_s, params_vote,
-                fmask, max_depth, with_categorical=False, cat_words=cat_words,
-                rand_bin=rand_bin, return_feature_gains=True)
+                fmask, max_depth, with_categorical=with_categorical,
+                cat_words=cat_words, rand_bin=rand_bin, bundle=bundle_s,
+                return_feature_gains=True)
             kk = min(vote_top_k, f)
             k2 = min(2 * vote_top_k, f)
             rank_local = jnp.argsort(jnp.argsort(-fgain, axis=1), axis=1)
@@ -630,7 +635,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             leaf_min=state.leaf_min if with_monotone else None,
             leaf_max=state.leaf_max if with_monotone else None,
             gain_adjust=slice_f(cegb_adjust(state)),
-            rand_bin=rand_bin, bundle=bundle_meta)
+            rand_bin=rand_bin, bundle=bundle_s)
         if fp_mode:
             # local feature index -> global, then allreduce-argmax of the
             # per-leaf bests (reference: SyncUpGlobalBestSplit,
@@ -683,8 +688,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         k_idx = state.forced_idx
         l = state.forced_slot[k_idx]
         lsafe = jnp.maximum(l, 0)
-        fmask_forced = (jnp.arange(f_loc, dtype=jnp.int32)
-                        == ff[k_idx]).astype(jnp.float32)
+        # ff holds GLOBAL feature indices; under feature slicing only the
+        # owning shard's mask lights up and the result syncs below
+        fidx = jnp.arange(f_loc, dtype=jnp.int32)
+        if fp_mode:
+            fidx = fidx + off
+        fmask_forced = (fidx == ff[k_idx]).astype(jnp.float32)
         # forced means forced: the reference gathers the threshold's sums
         # directly (GatherInfoForThreshold) without min_gain/min_data
         # screening, aborting only on gain < 0
@@ -700,7 +709,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             leaf_min=state.leaf_min if with_monotone else None,
             leaf_max=state.leaf_max if with_monotone else None,
             rand_bin=jnp.full((L, f_loc), ft[k_idx], jnp.int32),
-            bundle=bundle_meta)
+            bundle=bundle_s)
+        if fp_mode:
+            from ..ops.split import sync_best_splits
+            best = best._replace(feature=best.feature + off)
+            best = sync_best_splits(best, feature_axis_name)
         ok = ((l >= 0) & (state.num_leaves < L)
               & state.hist_valid[lsafe] & ~state.leaf_dead[lsafe]
               & jnp.isfinite(best.gain[lsafe]))

@@ -67,6 +67,8 @@ def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
     from .learners import ParallelGrower
     pg = _dp_growers.get((mesh, axis))
     if pg is None:
+        if len(_dp_growers) >= 4:     # bounded: drop the oldest grower
+            _dp_growers.pop(next(iter(_dp_growers)))
         pg = ParallelGrower("data", mesh=mesh, axis=axis)
         _dp_growers[(mesh, axis)] = pg
     tree, leaf_id, _aux = pg(

@@ -79,7 +79,7 @@ class ParallelGrower:
         self._cache = {}
         self._global_arrays = {}   # id(host arr) -> (host arr, global arr)
 
-    def _build(self, has_binsT: bool, grow_kwargs: tuple):
+    def _build(self, extras_spec: dict, grow_kwargs: tuple):
         axis = self.axis
         kw = dict(grow_kwargs)
         if self.mode == "data":
@@ -93,7 +93,6 @@ class ParallelGrower:
         rows_sharded = self.mode in ("data", "voting")
         row = P(axis) if rows_sharded else P()
         row2 = P(axis, None) if rows_sharded else P()
-        colT = P(None, axis) if rows_sharded else P()
         # multi-controller: replicate the leaf ids with an in-program
         # all_gather so every process can address the full vector for its
         # (replicated-data) score update — the per-machine score partition
@@ -101,25 +100,21 @@ class ParallelGrower:
         multiproc = jax.process_count() > 1
         gather_leaf = multiproc and rows_sharded
 
-        def run(bins, grad, hess, mask, meta, params, fmask, missing_bin,
-                binsT, rng_key):
+        def fn(bins, grad, hess, mask, meta, params, fmask, missing_bin,
+               extras, rng_key):
             tree, leaf_id, aux = grow_tree(
-                bins, grad, hess, mask, meta, params, fmask,
-                missing_bin, binsT=binsT, rng_key=rng_key, **kw)
+                bins, grad, hess, mask, meta, params, fmask, missing_bin,
+                binsT=extras.get("binsT"),
+                bundle_meta=extras.get("bundle"),
+                forced_splits=extras.get("forced"),
+                rng_key=rng_key, **kw)
             if gather_leaf:
                 leaf_id = jax.lax.all_gather(leaf_id, axis, tiled=True)
             return tree, leaf_id, aux
 
         leaf_spec = P() if gather_leaf else row
-        if has_binsT:
-            fn = run
-            in_specs = (row2, row, row, row, P(), P(), P(), P(), colT, P())
-        else:
-            def fn(bins, grad, hess, mask, meta, params, fmask, missing_bin,
-                   rng_key):
-                return run(bins, grad, hess, mask, meta, params, fmask,
-                           missing_bin, None, rng_key)
-            in_specs = (row2, row, row, row, P(), P(), P(), P(), P())
+        in_specs = (row2, row, row, row, P(), P(), P(), P(), extras_spec,
+                    P())
         out_specs = (P(), leaf_spec, GrowAux(P(), P()))
         return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
@@ -143,19 +138,24 @@ class ParallelGrower:
         out = jax.make_array_from_callback(host.shape, sharding,
                                            lambda idx: host[idx])
         if key is not None:
-            # keep the source alive so id() stays unique
+            # keep the source alive so id() stays unique; bounded so a
+            # long-lived process training over many Datasets doesn't pin
+            # every past dataset's host copy
+            if len(self._global_arrays) >= 8:
+                self._global_arrays.pop(next(iter(self._global_arrays)))
             self._global_arrays[id(key)] = (key, out)
         return out
 
     def __call__(self, bins, grad, hess, sample_mask, meta, params,
                  feature_mask, missing_bin, *, binsT=None, rng_key=None,
-                 **grow_kwargs):
+                 bundle_meta=None, forced_splits=None, **grow_kwargs):
         n, f = bins.shape
         d = self.ndev
         # pre-padding originals key the multi-process globalization cache
         # (padding allocates fresh arrays every call)
         orig_bins, orig_binsT = bins, binsT
         orig_meta, orig_missing_bin = meta, missing_bin
+        orig_bundle, orig_forced = bundle_meta, forced_splits
         # pad rows (data/voting shard rows) and features (data/feature
         # shard feature ownership) to multiples of the mesh size
         n_pad = (-n) % d if self.mode in ("data", "voting") else 0
@@ -173,6 +173,17 @@ class ParallelGrower:
                                   constant_values=-1)
             if binsT is not None:
                 binsT = jnp.pad(binsT, ((0, f_pad), (0, 0)))
+            if bundle_meta is not None:
+                # inert padded columns: regular (non-bundle) with the full
+                # bin range as their single segment
+                b = bundle_meta.seg_lo.shape[1]
+                bundle_meta = type(bundle_meta)(
+                    seg_lo=jnp.pad(bundle_meta.seg_lo, ((0, f_pad), (0, 0))),
+                    seg_hi=jnp.pad(bundle_meta.seg_hi, ((0, f_pad), (0, 0)),
+                                   constant_values=b - 1),
+                    is_bundle=jnp.pad(bundle_meta.is_bundle, (0, f_pad)),
+                    fwd_ok=jnp.pad(bundle_meta.fwd_ok, ((0, f_pad), (0, 0))),
+                    rev_ok=jnp.pad(bundle_meta.rev_ok, ((0, f_pad), (0, 0))))
         if rng_key is None:
             rng_key = jax.random.PRNGKey(0)
         if jax.process_count() > 1:
@@ -184,23 +195,44 @@ class ParallelGrower:
             grad = self._to_global(grad, row)
             hess = self._to_global(hess, row)
             sample_mask = self._to_global(sample_mask, row)
-            binsT = self._to_global(binsT, P(None, axis) if rows_sharded
-                                    else P(), key=orig_binsT)
             meta = type(meta)(*(self._to_global(a, P(), key=ka)
                                 for a, ka in zip(meta, orig_meta)))
             feature_mask = self._to_global(feature_mask, P())
             missing_bin = self._to_global(missing_bin, P(),
                                           key=orig_missing_bin)
 
-        key = (binsT is not None, tuple(sorted(grow_kwargs.items())))
+        extras = {}
+        extras_spec = {}
+        rows_sharded = self.mode in ("data", "voting")
+        multiproc = jax.process_count() > 1
+        if binsT is not None:
+            colT = P(None, self.axis) if rows_sharded else P()
+            extras["binsT"] = self._to_global(binsT, colT, key=orig_binsT) \
+                if multiproc else binsT
+            extras_spec["binsT"] = colT
+        if bundle_meta is not None:
+            if multiproc:
+                bundle_meta = type(bundle_meta)(
+                    *(self._to_global(a, P(), key=ka)
+                      for a, ka in zip(bundle_meta, orig_bundle)))
+            extras["bundle"] = bundle_meta
+            extras_spec["bundle"] = type(bundle_meta)(
+                *(P() for _ in bundle_meta))
+        if forced_splits is not None:
+            if multiproc:
+                forced_splits = tuple(
+                    self._to_global(a, P(), key=ka)
+                    for a, ka in zip(forced_splits, orig_forced))
+            extras["forced"] = forced_splits
+            extras_spec["forced"] = tuple(P() for _ in forced_splits)
+
+        key = (frozenset(extras), tuple(sorted(grow_kwargs.items())))
         shard = self._cache.get(key)
         if shard is None:
-            shard = self._build(binsT is not None,
+            shard = self._build(extras_spec,
                                 tuple(sorted(grow_kwargs.items())))
             self._cache[key] = shard
-        args = (bins, grad, hess, sample_mask, meta, params, feature_mask,
-                missing_bin)
-        if binsT is not None:
-            args += (binsT,)
-        tree, leaf_id, aux = shard(*args, rng_key)
+        tree, leaf_id, aux = shard(bins, grad, hess, sample_mask, meta,
+                                   params, feature_mask, missing_bin,
+                                   extras, rng_key)
         return tree, leaf_id[:n], aux

@@ -219,10 +219,11 @@ def test_reset_config_revalidates_tree_learner():
                                   "tree_learner": "data", "verbosity": -1},
                           train_set=ds)
     booster.update()
-    with pytest.raises(LightGBMError, match="extra_trees"):
+    with pytest.raises(LightGBMError, match="bynode"):
         booster._boosting.reset_config(Config.from_params(
             {"objective": "regression", "num_leaves": 7,
-             "tree_learner": "data", "extra_trees": True, "verbosity": -1}))
+             "tree_learner": "data", "feature_fraction_bynode": 0.5,
+             "verbosity": -1}))
 
 
 def test_sparse_predict_with_loaded_init_model():
