@@ -46,6 +46,20 @@ def _bagging_mask(key: jax.Array, frac, n: int) -> jax.Array:
     return (u < frac).astype(jnp.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def _bagging_subset(key: jax.Array, bins: jax.Array, k: int):
+    """Exact-k bagging selection + subset copy (gbdt.cpp:810-818 /
+    Dataset::CopySubrow): the k rows with the smallest random draws are
+    gathered into a compact [K, F] matrix so histogram passes scale with
+    the bagging fraction instead of full N."""
+    n = bins.shape[0]
+    r = jax.random.bits(key, (n,), jnp.uint32)
+    sub_idx = jnp.argsort(r)[:k].astype(jnp.int32)
+    mask = jnp.zeros((n,), jnp.float32).at[sub_idx].set(1.0)
+    sub_bins = jnp.take(bins, sub_idx, axis=0)
+    return mask, sub_idx, sub_bins, sub_bins.T
+
+
 class GBDT:
     """Gradient Boosting Decision Tree (reference: gbdt.h:42, boosting.h:27)."""
 
@@ -133,6 +147,7 @@ class GBDT:
         # come from the device PRNG keyed on bagging_seed
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._bag_mask = jnp.ones((n,), dtype=jnp.float32)
+        self._bag_sub = None
         self._need_bagging = (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0) or \
             (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
 
@@ -144,11 +159,28 @@ class GBDT:
         used = train_set.used_features
         self._with_monotone = any(int(m) != 0
                                   for m in (cfg.monotone_constraints or []))
-        if self._with_monotone and cfg.monotone_constraints_method not in (
-                "basic",):
-            log.warning(f"monotone_constraints_method="
-                        f"{cfg.monotone_constraints_method} is not implemented;"
-                        f" falling back to basic")
+        # static used-space indices of monotone-constrained features (the
+        # intermediate-mode pair masks are built only for these)
+        self._mono_features = tuple(
+            int(i) for i in np.nonzero(
+                np.asarray(train_set.feature_meta.monotone))[0])             if self._with_monotone else ()
+        self._mono_mode = "basic"
+        if self._with_monotone:
+            method = cfg.monotone_constraints_method
+            if method in ("intermediate", "advanced"):
+                self._mono_mode = "intermediate"
+                if method == "advanced":
+                    log.warning("monotone_constraints_method=advanced is not"
+                                " implemented; falling back to intermediate")
+                # exact output bounds are recomputed from all leaf outputs
+                # each phase, which requires strict one-split-per-phase
+                # growth (matching the reference's re-search-after-update,
+                # monotone_constraints.hpp:565)
+                log.info("monotone intermediate mode: strict leaf-wise "
+                         "growth order enabled")
+            elif method not in ("basic",):
+                log.warning(f"monotone_constraints_method={method} is not "
+                            f"implemented; falling back to basic")
         self._with_interactions = bool(cfg.interaction_constraints)
         self._interaction_groups = None
         if self._with_interactions:
@@ -307,6 +339,12 @@ class GBDT:
         self._need_bagging = (config.bagging_freq > 0 and config.bagging_fraction < 1.0) or \
             (config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0)
         self._bag_frac = None   # fractions may have changed
+        if not self._need_bagging:
+            # bagging switched off mid-training: drop the frozen subset/mask
+            self._bag_sub = None
+            self._bag_mask = jnp.ones((self.train_set.num_data,),
+                                      dtype=jnp.float32) \
+                if self.train_set is not None else self._bag_mask
 
     def add_valid(self, valid_set: Dataset, name: str) -> None:
         valid_set.construct()
@@ -335,6 +373,24 @@ class GBDT:
         if cfg.bagging_freq <= 0 or self.iter % cfg.bagging_freq != 0:
             return
         n = self.train_set.num_data
+        # subset copy when the fraction is small enough that compacting
+        # beats masked full-N histogram passes (the reference's rule,
+        # gbdt.cpp:810-818); serial learner, plain fraction only
+        use_subset = (cfg.bagging_fraction <= 0.5
+                      and cfg.pos_bagging_fraction >= 1.0
+                      and cfg.neg_bagging_fraction >= 1.0
+                      and self._parallel_grower is None
+                      and self._cegb_mode == "off"
+                      and not cfg.linear_tree)
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed),
+                                 self.iter)
+        if use_subset:
+            k = max(1, int(round(n * cfg.bagging_fraction)))
+            self._bag_mask, sub_idx, sub_bins, sub_binsT = _bagging_subset(
+                key, self.train_set.bins, k)
+            self._bag_sub = (sub_idx, sub_bins, sub_binsT)
+            return
+        self._bag_sub = None
         if getattr(self, "_bag_frac", None) is None:
             if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
                 pos = self.objective.label_np > 0 \
@@ -345,8 +401,6 @@ class GBDT:
                     cfg.neg_bagging_fraction).astype(np.float32))
             else:
                 self._bag_frac = jnp.float32(cfg.bagging_fraction)
-        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed),
-                                 self.iter)
         self._bag_mask = _bagging_mask(key, self._bag_frac, n)
 
     def _feature_mask(self) -> jax.Array:
@@ -446,17 +500,25 @@ class GBDT:
                 exact=cfg.tree_growth_mode == "exact",
                 with_categorical=ts.has_categorical,
                 with_monotone=self._with_monotone,
+                mono_mode=self._mono_mode,
+                mono_features=self._mono_features,
                 extra_trees=cfg.extra_trees,
                 vote_top_k=cfg.top_k, hist_dp=self._hist_dp)
+        sub = self._bag_sub
         return grow_tree(
             ts.bins, gc, hc, mask,
             ts.feature_meta, self.split_params, fmask, ts.missing_bin,
             max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
             max_depth=cfg.max_depth, hist_method=hm,
             binsT=ts.bins_T if hm.startswith(("onehot", "pallas")) else None,
+            sub_idx=sub[0] if sub else None,
+            sub_bins=sub[1] if sub else None,
+            sub_binsT=sub[2] if sub else None,
             exact=cfg.tree_growth_mode == "exact",
             with_categorical=ts.has_categorical,
             with_monotone=self._with_monotone,
+            mono_mode=self._mono_mode,
+            mono_features=self._mono_features,
             with_interactions=self._with_interactions,
             interaction_groups=self._interaction_groups,
             cegb_mode=self._cegb_mode,

@@ -76,6 +76,7 @@ class GrowAux(NamedTuple):
 
 class GrowState(NamedTuple):
     leaf_id: jax.Array       # [N] int32
+    leaf_id_sub: jax.Array   # [K] int32 (bagging subset) or [1]
     hist: jax.Array          # [L, F, B, 3]
     hist_valid: jax.Array    # [L] bool
     leaf_dead: jax.Array     # [L] bool (guard-failed, never splittable)
@@ -86,6 +87,8 @@ class GrowState(NamedTuple):
     leaf_depth: jax.Array    # [L] int32
     leaf_min: jax.Array      # [L] monotone output lower bound
     leaf_max: jax.Array      # [L] monotone output upper bound
+    leaf_lo: jax.Array       # [L, F] int32 region box lo (intermediate) or [1,1]
+    leaf_hi: jax.Array       # [L, F] int32 region box hi (inclusive)
     used_path: jax.Array     # [L, F] bool (interaction constraints) or [1,1]
     used_split: jax.Array    # [F] bool (CEGB coupled)
     row_used: jax.Array      # [N, F] bool (CEGB lazy) or [1,1]
@@ -105,7 +108,10 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
                  missing_bin: jax.Array,
                  gain_eff: jax.Array, meta: FeatureMeta, *,
                  with_monotone: bool, with_interactions: bool,
-                 cegb_lazy: bool) -> Tuple[GrowState, jax.Array]:
+                 cegb_lazy: bool,
+                 mono_intermediate: bool = False,
+                 sub_bins: jax.Array | None = None,
+                 sub_binsT: jax.Array | None = None) -> Tuple[GrowState, jax.Array]:
     """Split the current best leaf (reference: SerialTreeLearner::Split,
     serial_tree_learner.cpp:564-682 + Tree::Split, tree.h:62)."""
     l = jnp.argmax(gain_eff).astype(jnp.int32)
@@ -119,29 +125,40 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
     dleft = best.default_left[l]
     is_cat = best.is_cat[l]
     bitset = best.cat_bitset[l]
+    mb = missing_bin[feat]
+    seg_lo = best.seg_lo[l]
+    seg_hi = best.seg_hi[l]
 
     # --- rows of leaf l route left/right. A feature-major ``binsT`` makes
     # the column extraction a contiguous dynamic slice instead of a strided
     # read of the whole row-major matrix (matters at 10M+ rows).
-    if binsT is not None:
-        col = jax.lax.dynamic_slice_in_dim(binsT, feat, 1, 0)[0].astype(jnp.int32)
-    else:
-        col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
-    mb = missing_bin[feat]
-    num_left = jnp.where((col == mb) & (mb >= 0), dleft, col <= thr)
-    # EFB bundle split: rows outside the owning member's segment are its
-    # default mass and route by the default direction (bundling.py layout)
-    seg_lo = best.seg_lo[l]
-    seg_hi = best.seg_hi[l]
-    in_seg = (col >= seg_lo) & (col <= seg_hi)
-    num_left = jnp.where(seg_lo >= 0,
-                         jnp.where(in_seg, col <= thr, dleft), num_left)
-    # categorical: bitset membership (Tree::CategoricalDecision, tree.h:349)
-    word = jnp.take(bitset, col >> 5)
-    cat_left = ((word >> (col & 31).astype(jnp.uint32)) & 1) == 1
-    go_left = jnp.where(is_cat, cat_left, num_left)
+    def route(bins_m, binsT_m, leaf_vec):
+        if binsT_m is not None:
+            colv = jax.lax.dynamic_slice_in_dim(binsT_m, feat, 1,
+                                                0)[0].astype(jnp.int32)
+        else:
+            colv = jnp.take(bins_m, feat, axis=1).astype(jnp.int32)
+        numl = jnp.where((colv == mb) & (mb >= 0), dleft, colv <= thr)
+        # EFB bundle split: rows outside the owning member's segment are
+        # its default mass and route by the default direction
+        in_seg = (colv >= seg_lo) & (colv <= seg_hi)
+        numl = jnp.where(seg_lo >= 0,
+                         jnp.where(in_seg, colv <= thr, dleft), numl)
+        # categorical: bitset membership (Tree::CategoricalDecision,
+        # tree.h:349)
+        word = jnp.take(bitset, colv >> 5)
+        catl = ((word >> (colv & 31).astype(jnp.uint32)) & 1) == 1
+        gol = jnp.where(is_cat, catl, numl)
+        return jnp.where((leaf_vec == l) & ~gol, new_leaf, leaf_vec)
+
     in_leaf = state.leaf_id == l
-    leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_id)
+    leaf_id = route(bins, binsT, state.leaf_id)
+    # bagging-subset mode: the compacted in-bag rows route in parallel so
+    # histogram passes stay subset-sized (GBDT subset copy,
+    # gbdt.cpp:810-818 / Dataset::CopySubrow)
+    leaf_id_sub = state.leaf_id_sub
+    if sub_bins is not None:
+        leaf_id_sub = route(sub_bins, sub_binsT, state.leaf_id_sub)
 
     # --- tree arrays: fix the parent link that pointed at leaf l
     parent = tree.leaf_parent[l]
@@ -198,6 +215,22 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
         leaf_min = leaf_min.at[l].set(lmin).at[new_leaf].set(rmin)
         leaf_max = leaf_max.at[l].set(lmax).at[new_leaf].set(rmax)
 
+    # intermediate monotone mode tracks per-leaf bin-interval boxes: a
+    # numerical split partitions the split feature's interval; categorical
+    # splits leave both children's boxes unchanged (conservative overlap,
+    # like the reference's always-go-down categorical handling,
+    # monotone_constraints.hpp GoDownToFindLeavesToUpdate)
+    leaf_lo, leaf_hi = state.leaf_lo, state.leaf_hi
+    if mono_intermediate:
+        parent_lo, parent_hi = leaf_lo[l], leaf_hi[l]
+        num_split = ~is_cat
+        lhi = jnp.where((jnp.arange(parent_hi.shape[0]) == feat) & num_split,
+                        jnp.minimum(parent_hi, thr), parent_hi)
+        rlo = jnp.where((jnp.arange(parent_lo.shape[0]) == feat) & num_split,
+                        jnp.maximum(parent_lo, thr + 1), parent_lo)
+        leaf_lo = leaf_lo.at[new_leaf].set(rlo)
+        leaf_hi = leaf_hi.at[l].set(lhi).at[new_leaf].set(parent_hi)
+
     used_path = state.used_path
     if with_interactions:
         parent_used = state.used_path[l].at[feat].set(True)
@@ -212,6 +245,7 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
 
     state = state._replace(
         leaf_id=leaf_id,
+        leaf_id_sub=leaf_id_sub,
         tree=tree,
         hist_valid=state.hist_valid.at[l].set(False).at[new_leaf].set(False),
         leaf_sum_g=state.leaf_sum_g.at[l].set(best.left_sum_g[l])
@@ -225,6 +259,7 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
         leaf_depth=state.leaf_depth.at[l].set(new_depth)
                                    .at[new_leaf].set(new_depth),
         leaf_min=leaf_min, leaf_max=leaf_max,
+        leaf_lo=leaf_lo, leaf_hi=leaf_hi,
         used_path=used_path, used_split=used_split, row_used=row_used,
         # slot l inherits the parent's histogram data (the basis of the
         # subtraction trick, serial_tree_learner.cpp:311-320)
@@ -240,6 +275,7 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
     jax.jit,
     static_argnames=("max_leaves", "num_bins", "max_depth", "hist_method",
                      "exact", "axis_name", "with_categorical", "with_monotone",
+                     "mono_mode", "mono_features",
                      "with_interactions", "cegb_mode", "extra_trees",
                      "use_bynode", "tile_leaves", "hist_subtraction",
                      "feature_axis_name", "feature_shards", "voting",
@@ -252,6 +288,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               exact: bool = False,
               with_categorical: bool = False,
               with_monotone: bool = False,
+              mono_mode: str = "basic",
+              mono_features: tuple = (),
               with_interactions: bool = False,
               interaction_groups: jax.Array | None = None,
               cegb_mode: str = "off",
@@ -264,6 +302,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               rng_key: jax.Array | None = None,
               axis_name: str | None = None,
               binsT: jax.Array | None = None,
+              sub_idx: jax.Array | None = None,
+              sub_bins: jax.Array | None = None,
+              sub_binsT: jax.Array | None = None,
               tile_leaves: int = 42,
               hist_subtraction: bool = True,
               feature_axis_name: str | None = None,
@@ -389,8 +430,25 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # model (hist_t, bin.h:32) / the gpu_use_dp flag's double mode; needs
     # jax x64 (the caller warns otherwise)
     hist_dtype = jnp.float64 if hist_dp else jnp.float32
-    stats = jnp.stack([grad * sample_mask, hess * sample_mask, sample_mask],
-                      axis=1).astype(hist_dtype)
+    use_subset = sub_idx is not None
+    if use_subset:
+        # bagging subset copy (gbdt.cpp:810-818): histograms and root sums
+        # run over the compacted in-bag rows only — pass cost scales with
+        # the bagging fraction instead of full N. Full-row routing still
+        # happens for the out-of-bag score update. Serial learner only.
+        assert not fp_mode and not voting and axis_name is None, (
+            "bagging subset copy is serial-only; distributed learners use "
+            "the mask path")
+        g_sub = jnp.take(grad, sub_idx)
+        h_sub = jnp.take(hess, sub_idx)
+        stats = jnp.stack([g_sub, h_sub, jnp.ones_like(g_sub)],
+                          axis=1).astype(hist_dtype)
+        bins_h = sub_bins
+        binsT_h = sub_binsT
+    else:
+        stats = jnp.stack(
+            [grad * sample_mask, hess * sample_mask, sample_mask],
+            axis=1).astype(hist_dtype)
     root = jnp.sum(stats, axis=0)
     if axis_name is not None:
         root = jax.lax.psum(root, axis_name)
@@ -402,6 +460,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         rng_key = jax.random.PRNGKey(0)
 
     iota_l = jnp.arange(L, dtype=jnp.int32)
+    mono_intermediate = with_monotone and mono_mode == "intermediate"
+    # intermediate-mode constraints are recomputed from ALL current leaf
+    # outputs at the start of each split phase, so the strict one-split-per-
+    # phase order is required for soundness (the reference re-searches the
+    # leaves_to_update set after every split, monotone_constraints.hpp:565)
+    exact = exact or mono_intermediate
 
     def init_state() -> GrowState:
         zf = functools.partial(jnp.zeros, dtype=hist_dtype)
@@ -420,6 +484,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             row_used = jnp.zeros((n, f) if cegb_lazy else (1, 1), bool)
         return GrowState(
             leaf_id=jnp.zeros((n,), jnp.int32),
+            leaf_id_sub=jnp.zeros((sub_idx.shape[0],) if use_subset else (1,),
+                                  jnp.int32),
             hist=jnp.zeros((L, f_loc, num_bins, 3), hist_dtype),
             hist_valid=jnp.zeros((L,), bool),
             leaf_dead=jnp.zeros((L,), bool),
@@ -430,6 +496,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             leaf_depth=jnp.zeros((L,), jnp.int32),
             leaf_min=jnp.full((L,), -F32_MAX, hist_dtype),
             leaf_max=jnp.full((L,), F32_MAX, hist_dtype),
+            leaf_lo=jnp.zeros((L, f) if mono_intermediate else (1, 1),
+                              jnp.int32),
+            leaf_hi=(jnp.broadcast_to(meta.num_bins[None, :] - 1, (L, f))
+                     .astype(jnp.int32) if mono_intermediate
+                     else jnp.zeros((1, 1), jnp.int32)),
             used_path=jnp.zeros((L, f) if with_interactions else (1, 1), bool),
             used_split=used_split,
             row_used=row_used,
@@ -538,7 +609,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         chosen_ok = cand[chosen]
         sel = jnp.where(chosen_ok, chosen, -1)
 
-        tile = histogram_tiles(bins_h, stats, state.leaf_id, sel, num_bins,
+        hist_leaf_ids = state.leaf_id_sub if use_subset else state.leaf_id
+        tile = histogram_tiles(bins_h, stats, hist_leaf_ids, sel, num_bins,
                                method=hist_method, dtype=hist_dtype,
                                binsT=binsT_h)
         if dp_scatter:
@@ -573,7 +645,45 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             parent_hist=state.parent_hist & ~resolved,
             rounds=state.rounds + 1)
 
+    def intermediate_bounds(state: GrowState) -> GrowState:
+        """Exact per-leaf output bounds from ALL current leaf outputs and
+        the leaf region boxes — the vectorized re-derivation of the
+        reference's intermediate-mode constraint maintenance
+        (monotone_constraints.hpp:514-698 IntermediateLeafConstraints: its
+        GoUp/GoDown contiguity walk incrementally maintains the same
+        pairwise relations this computes from scratch each phase). A pair
+        (l, l') constrains l when their boxes overlap in every feature
+        except a monotone one where l' lies strictly on one side."""
+        out = state.leaf_output.astype(jnp.float32)
+        act = active_mask(state)
+        lo, hi = state.leaf_lo, state.leaf_hi               # [L, F]
+        # overlap COUNT over all features reduces without materializing the
+        # [L, L, F] tensor; the per-feature pair masks are only needed for
+        # the (static, usually few) monotone-constrained features
+        cnt = jnp.sum((lo[:, None, :] <= hi[None, :, :])
+                      & (lo[None, :, :] <= hi[:, None, :]),
+                      axis=2, dtype=jnp.int32)               # [L, L']
+        mf = jnp.asarray(mono_features, jnp.int32)           # [Fm] static
+        lo_m, hi_m = lo[:, mf], hi[:, mf]                    # [L, Fm]
+        ovl_m = ((lo_m[:, None, :] <= hi_m[None, :, :])
+                 & (lo_m[None, :, :] <= hi_m[:, None, :]))
+        except_f = (cnt[:, :, None] - ovl_m.astype(jnp.int32)) == (f - 1)
+        below = hi_m[None, :, :] < lo_m[:, None, :]          # l' below l
+        above = lo_m[None, :, :] > hi_m[:, None, :]
+        mono = meta.monotone[mf].astype(jnp.int32)
+        up = (mono > 0)[None, None, :]
+        dn = (mono < 0)[None, None, :]
+        pair_ok = (act[:, None, None] & act[None, :, None] & except_f)
+        lb_mask = jnp.any(pair_ok & ((up & below) | (dn & above)), axis=2)
+        ub_mask = jnp.any(pair_ok & ((up & above) | (dn & below)), axis=2)
+        lb = jnp.max(jnp.where(lb_mask, out[None, :], -F32_MAX), axis=1)
+        ub = jnp.min(jnp.where(ub_mask, out[None, :], F32_MAX), axis=1)
+        return state._replace(leaf_min=lb.astype(state.leaf_min.dtype),
+                              leaf_max=ub.astype(state.leaf_max.dtype))
+
     def split_phase(state: GrowState) -> GrowState:
+        if mono_intermediate:
+            state = intermediate_bounds(state)
         round_key = jax.random.fold_in(rng_key, state.rounds)
         fmask = slice_f(leaf_feature_mask(state, round_key))
         rand_bin = None
@@ -651,7 +761,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         apply_kw = dict(with_monotone=with_monotone,
                         with_interactions=with_interactions,
-                        cegb_lazy=cegb_lazy)
+                        cegb_lazy=cegb_lazy,
+                        mono_intermediate=mono_intermediate,
+                        sub_bins=sub_bins, sub_binsT=sub_binsT)
 
         if exact:
             # strict best-first: one split per phase, then recompute children
@@ -684,6 +796,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         restricted to the forced bin and min_gain disabled, so sums and
         missing/default semantics are exact; a forced split its constraints
         reject is skipped along with its whole subtree."""
+        if mono_intermediate:
+            state = intermediate_bounds(state)
         ff, ft, fl, fr = forced_splits
         k_idx = state.forced_idx
         l = state.forced_slot[k_idx]
@@ -725,7 +839,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             st2, _ = _apply_split(st, bins, binsT, missing_bin, ge, meta,
                                   with_monotone=with_monotone,
                                   with_interactions=with_interactions,
-                                  cegb_lazy=cegb_lazy)
+                                  cegb_lazy=cegb_lazy,
+                                  mono_intermediate=mono_intermediate,
+                                  sub_bins=sub_bins, sub_binsT=sub_binsT)
             return st2
 
         state = jax.lax.cond(ok, do_split, lambda s: s, state)

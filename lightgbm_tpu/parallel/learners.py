@@ -132,6 +132,10 @@ class ParallelGrower:
         if key is not None:
             hit = self._global_arrays.get(id(key))
             if hit is not None and hit[0] is key:
+                # LRU: refresh on hit so the per-call working set (up to
+                # ~18 keyed arrays with binsT+bundle+forced) never thrashes
+                self._global_arrays.pop(id(key))
+                self._global_arrays[id(key)] = hit
                 return hit[1]
         host = np.asarray(arr)
         sharding = jax.sharding.NamedSharding(self.mesh, spec)
@@ -141,7 +145,7 @@ class ParallelGrower:
             # keep the source alive so id() stays unique; bounded so a
             # long-lived process training over many Datasets doesn't pin
             # every past dataset's host copy
-            if len(self._global_arrays) >= 8:
+            if len(self._global_arrays) >= 64:
                 self._global_arrays.pop(next(iter(self._global_arrays)))
             self._global_arrays[id(key)] = (key, out)
         return out

@@ -187,3 +187,63 @@ def test_monotone_penalty(reg_data):
     for ti in base.dump_model()["tree_info"]:
         base_shallow += shallow_feats(ti["tree_structure"], 0, [])
     assert 0 in base_shallow
+
+
+def test_monotone_intermediate_enforced(reg_data):
+    """Intermediate mode keeps the monotone guarantee (sweep check) while
+    constraining less than basic (monotone_constraints.hpp:514
+    IntermediateLeafConstraints: children bounded by actual sibling
+    outputs, other leaves re-bounded from real outputs)."""
+    X, y = reg_data
+    params = dict(BASE, monotone_constraints=[1, -1, 0, 0],
+                  monotone_constraints_method="intermediate")
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, num_boost_round=20)
+    p0 = _sweep(booster, 0)
+    assert np.all(np.diff(p0) >= -1e-6), "monotone +1 violated"
+    p1 = _sweep(booster, 1)
+    assert np.all(np.diff(p1) <= 1e-6), "monotone -1 violated"
+    # 2-d monotonicity on a grid: fix x1, vary x0 and vice versa
+    g = np.zeros((40, 4))
+    g[:, 0] = np.linspace(-2, 2, 40)
+    for x1 in (-1.5, 0.0, 1.5):
+        g[:, 1] = x1
+        pv = booster.predict(g)
+        assert np.all(np.diff(pv) >= -1e-6)
+
+
+def test_monotone_intermediate_beats_basic():
+    """The reference's motivation for the mode (test_engine.py:1256-style):
+    basic's midpoint bounds over-constrain, so intermediate must fit the
+    same monotone data at least as well — and strictly better on data
+    designed to expose the over-constraint (a steep monotone step plus a
+    strong secondary feature)."""
+    rng = np.random.RandomState(3)
+    n = 3000
+    X = rng.uniform(-2, 2, size=(n, 3))
+    # steep monotone step in x0 + large additive x1 effect: basic's
+    # midpoint propagation forces wide dead zones around the step
+    y = (4.0 * (X[:, 0] > 0) + X[:, 0] + 2.5 * np.sin(2 * X[:, 1])
+         + 0.1 * rng.normal(size=n))
+
+    def fit(method):
+        params = {"objective": "regression", "num_leaves": 31,
+                  "min_data_in_leaf": 5, "verbosity": -1,
+                  "monotone_constraints": [1, 0, 0],
+                  "monotone_constraints_method": method}
+        ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+        booster = lgb.train(params, ds, num_boost_round=30)
+        mse = float(np.mean((booster.predict(X) - y) ** 2))
+        return mse, booster
+
+    mse_basic, _ = fit("basic")
+    mse_inter, b_inter = fit("intermediate")
+    assert mse_inter <= mse_basic * 1.001, (mse_basic, mse_inter)
+    assert mse_inter < mse_basic * 0.95, (
+        "intermediate should fit notably better here", mse_basic, mse_inter)
+    # and the constraint still holds
+    g = np.zeros((50, 3))
+    g[:, 0] = np.linspace(-2, 2, 50)
+    for x1 in (-1.0, 1.0):
+        g[:, 1] = x1
+        assert np.all(np.diff(b_inter.predict(g)) >= -1e-6)

@@ -223,3 +223,41 @@ def test_no_split_when_constant_gradient_zero():
         bins, grad, hess, [8, 8], num_leaves=8)
     assert int(tree.num_leaves) == 1
     assert np.all(leaf_id == 0)
+
+
+def test_bagging_subset_matches_mask():
+    """grow_tree with a compacted bagging subset (sub_idx/sub_bins) must
+    grow the identical tree as the mask formulation over the same selected
+    rows (gbdt.cpp:810-818 subset copy semantics)."""
+    rng = np.random.RandomState(23)
+    n, f, b = 1200, 5, 16
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = np.ones(n, dtype=np.float32)
+    sel = rng.uniform(size=n) < 0.4
+    sub_idx = np.nonzero(sel)[0].astype(np.int32)
+    meta, missing_bin = _make_meta([b] * f)
+    params = _make_params(min_data=5)
+
+    common = (jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess))
+    tree_m, leaf_m, _ = grow_tree(
+        *common, jnp.asarray(sel.astype(np.float32)), meta, params,
+        jnp.ones((f,), jnp.float32), jnp.asarray(missing_bin),
+        max_leaves=8, num_bins=b)
+    sub_bins = jnp.asarray(bins[sub_idx])
+    tree_s, leaf_s, _ = grow_tree(
+        *common, jnp.ones((n,), jnp.float32), meta, params,
+        jnp.ones((f,), jnp.float32), jnp.asarray(missing_bin),
+        max_leaves=8, num_bins=b,
+        sub_idx=jnp.asarray(sub_idx), sub_bins=sub_bins,
+        sub_binsT=jnp.asarray(np.ascontiguousarray(bins[sub_idx].T)))
+    assert int(tree_m.num_leaves) == int(tree_s.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree_m.node_feature),
+                                  np.asarray(tree_s.node_feature))
+    np.testing.assert_array_equal(np.asarray(tree_m.node_threshold_bin),
+                                  np.asarray(tree_s.node_threshold_bin))
+    np.testing.assert_allclose(np.asarray(tree_m.leaf_value),
+                               np.asarray(tree_s.leaf_value),
+                               rtol=1e-5, atol=1e-7)
+    # full-row routing agrees (out-of-bag rows included in the score update)
+    np.testing.assert_array_equal(np.asarray(leaf_m), np.asarray(leaf_s))
