@@ -27,20 +27,24 @@ FULL_ROWS = 10_500_000
 PEAK_F32_FLOPS = 98e12
 
 
-def run_at_scale(rows, args):
+def run_at_scale(rows, args, hist_method="auto"):
     import numpy as np
     import jax
     import lightgbm_tpu as lgb
 
     phases = {}
     rng = np.random.RandomState(0)
+    # train + held-out valid rows from the same synthetic distribution
+    n_valid = min(args.valid_rows, rows // 10)
     n, f = rows, args.features
     t0 = time.time()
     # Higgs-shaped synthetic: continuous physics-like features, binary label
-    X = rng.normal(size=(n, f)).astype(np.float32)
+    X = rng.normal(size=(n + n_valid, f)).astype(np.float32)
     w = rng.normal(size=f)
     logits = X[:, : f // 2] @ w[: f // 2] + 0.5 * np.sin(X[:, f // 2]) * X[:, 0]
-    y = (logits + rng.logistic(size=n) > 0).astype(np.float32)
+    y = (logits + rng.logistic(size=n + n_valid) > 0).astype(np.float32)
+    Xv, yv = X[n:], y[n:]
+    X, y = X[:n], y[:n]
     phases["datagen"] = time.time() - t0
 
     t0 = time.time()
@@ -53,6 +57,7 @@ def run_at_scale(rows, args):
         "objective": "binary", "num_leaves": args.num_leaves,
         "learning_rate": 0.1, "max_bin": args.max_bin,
         "min_data_in_leaf": 100, "min_sum_hessian_in_leaf": 100.0,
+        "histogram_method": hist_method,
         "verbosity": -1,
     }, train_set=ds)
 
@@ -74,7 +79,30 @@ def run_at_scale(rows, args):
     _ = float(booster._boosting.train_score[0])
     sec_per_iter = (time.time() - t0) / args.iters
     phases["sec_per_iter"] = sec_per_iter
-    return sec_per_iter, phases
+
+    # quality anchor: continue to --rounds total iterations, then held-out
+    # AUC (speed without a matched-accuracy number is unfalsifiable)
+    auc = None
+    done = 2 + args.iters
+    if args.rounds > done and n_valid > 0:
+        t0 = time.time()
+        for _ in range(args.rounds - done):
+            booster.update()
+        _ = float(booster._boosting.train_score[0])
+        phases["extra_rounds"] = time.time() - t0
+    if n_valid > 0:
+        t0 = time.time()
+        score = booster.predict(Xv, raw_score=True)
+        order = np.argsort(score, kind="mergesort")
+        ys = yv[order]
+        npos = ys.sum()
+        nneg = len(ys) - npos
+        if npos > 0 and nneg > 0:
+            ranks = np.arange(1, len(ys) + 1)
+            auc = float((ranks[ys > 0].sum() - npos * (npos + 1) / 2)
+                        / (npos * nneg))
+        phases["valid_auc_predict"] = time.time() - t0
+    return sec_per_iter, phases, auc, max(args.rounds, done)
 
 
 def main():
@@ -85,6 +113,10 @@ def main():
     ap.add_argument("--max-bin", type=int, default=255)
     ap.add_argument("--iters", type=int, default=10,
                     help="timed iterations (after 2 warmup)")
+    ap.add_argument("--rounds", type=int, default=100,
+                    help="total boosting rounds before the AUC readout")
+    ap.add_argument("--valid-rows", type=int, default=500_000,
+                    help="held-out rows for the AUC readout (0 disables)")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     ap.add_argument("--no-ladder", action="store_true",
                     help="fail instead of retrying at smaller scales")
@@ -101,16 +133,26 @@ def main():
         r for r in (args.rows, 2_000_000, 500_000) if r <= args.rows))
     if args.no_ladder:
         ladder = [args.rows]
-    sec_per_iter = phases = used_rows = None
+    sec_per_iter = phases = used_rows = auc = rounds_run = None
+    used_method = None
+    # the method ladder guards against a kernel-specific failure: "auto"
+    # (the fused Pallas fast path on TPU) falls back to the XLA onehot
+    # contraction at the same scale before shrinking rows
     for rows in ladder:
-        try:
-            print(f"# trying rows={rows}", file=sys.stderr)
-            sec_per_iter, phases = run_at_scale(rows, args)
-            used_rows = rows
+        for hm in ("auto", "onehot"):
+            try:
+                print(f"# trying rows={rows} hist={hm}", file=sys.stderr)
+                sec_per_iter, phases, auc, rounds_run = run_at_scale(
+                    rows, args, hist_method=hm)
+                used_rows = rows
+                used_method = hm
+                break
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+                print(f"# rows={rows} hist={hm} failed; falling back",
+                      file=sys.stderr)
+        if used_rows is not None:
             break
-        except Exception:
-            traceback.print_exc(file=sys.stderr)
-            print(f"# rows={rows} failed; falling back", file=sys.stderr)
 
     if sec_per_iter is None:
         print(json.dumps({"metric": "higgs_sec_per_iter", "value": None,
@@ -142,6 +184,9 @@ def main():
         "vs_baseline": round(scaled_baseline / sec_per_iter, 4),
         "rows": used_rows,
         "mfu_est": round(mfu, 4),
+        "auc": round(auc, 6) if auc is not None else None,
+        "auc_rounds": rounds_run,
+        "hist_method": used_method,
         "phases": {k: round(v, 3) for k, v in phases.items()},
     }))
 

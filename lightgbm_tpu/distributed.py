@@ -40,7 +40,16 @@ _initialized = False
 
 
 def is_initialized() -> bool:
-    return _initialized
+    return _initialized or _jax_already_initialized()
+
+
+def _jax_already_initialized() -> bool:
+    """True when jax.distributed was initialized (by us or externally)."""
+    try:
+        from jax._src import distributed as jax_dist
+        return jax_dist.global_state.client is not None
+    except Exception:
+        return False
 
 
 def _local_addresses() -> set:
@@ -103,6 +112,12 @@ def init(machines: Optional[str] = None,
         log.warning("distributed.init called twice; ignoring")
         return
     import jax
+    if _jax_already_initialized():
+        # standard JAX practice initializes jax.distributed once at process
+        # startup; treat that as ours rather than crashing on re-init
+        log.info("jax.distributed already initialized externally; adopting")
+        _initialized = True
+        return
 
     listen_port = None
     if params:
@@ -156,6 +171,8 @@ def maybe_init_from_config(config) -> None:
     distributed training was not explicitly initialized (the CLI flow,
     application.cpp:167-178: Network::Init happens before training)."""
     if _initialized:
+        return
+    if _jax_already_initialized():
         return
     nm = int(getattr(config, "num_machines", 1) or 1)
     if nm > 1:

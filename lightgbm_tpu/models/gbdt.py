@@ -161,9 +161,12 @@ class GBDT:
                                   for m in (cfg.monotone_constraints or []))
         # static used-space indices of monotone-constrained features (the
         # intermediate-mode pair masks are built only for these)
-        self._mono_features = tuple(
-            int(i) for i in np.nonzero(
-                np.asarray(train_set.feature_meta.monotone))[0])             if self._with_monotone else ()
+        if self._with_monotone:
+            mono_np = np.asarray(train_set.feature_meta.monotone)
+            self._mono_features = tuple(int(i)
+                                        for i in np.nonzero(mono_np)[0])
+        else:
+            self._mono_features = ()
         self._mono_mode = "basic"
         if self._with_monotone:
             method = cfg.monotone_constraints_method

@@ -113,6 +113,19 @@ def split_hilo(rhs: jax.Array) -> jax.Array:
     return jnp.concatenate([rhs_hi, rhs_lo], axis=1)
 
 
+def _histogram_tiles_pallas(binsT, stats, leaf_ids, sel, num_bins, block,
+                            hilo):
+    f = binsT.shape[0]
+    p = sel.shape[0]
+    s = stats.shape[1]
+    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block)
+    if hilo:
+        rhs = split_hilo(rhs)
+    out = _hist_pallas_call(binsT, rhs, num_bins=num_bins, block=c,
+                            hilo=hilo)
+    return out[:, :p * s].reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
+
+
 def histogram_tiles_pallas(binsT: jax.Array, stats: jax.Array,
                            leaf_ids: jax.Array, sel: jax.Array,
                            num_bins: int, block: int = 2048) -> jax.Array:
@@ -122,13 +135,8 @@ def histogram_tiles_pallas(binsT: jax.Array, stats: jax.Array,
     matrix [F, N] (contiguous per-feature rows for the kernel's block
     loads).
     """
-    f, n = binsT.shape
-    p = sel.shape[0]
-    s = stats.shape[1]
-    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block)
-    out = _hist_pallas_call(binsT, rhs, num_bins=num_bins, block=c,
-                            hilo=False)
-    return out[:, :p * s].reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
+    return _histogram_tiles_pallas(binsT, stats, leaf_ids, sel, num_bins,
+                                   block, hilo=False)
 
 
 def histogram_tiles_pallas_hilo(binsT: jax.Array, stats: jax.Array,
@@ -136,10 +144,5 @@ def histogram_tiles_pallas_hilo(binsT: jax.Array, stats: jax.Array,
                                 num_bins: int, block: int = 2048) -> jax.Array:
     """[P, F, B, S] histogram tile via the fused kernel, hi/lo bf16 matmuls
     (the fast default — see the module docstring's precision model)."""
-    f, n = binsT.shape
-    p = sel.shape[0]
-    s = stats.shape[1]
-    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block)
-    out = _hist_pallas_call(binsT, split_hilo(rhs), num_bins=num_bins,
-                            block=c, hilo=True)
-    return out[:, :p * s].reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
+    return _histogram_tiles_pallas(binsT, stats, leaf_ids, sel, num_bins,
+                                   block, hilo=True)

@@ -137,10 +137,15 @@ class ParallelGrower:
                 self._global_arrays.pop(id(key))
                 self._global_arrays[id(key)] = hit
                 return hit[1]
-        host = np.asarray(arr)
         sharding = jax.sharding.NamedSharding(self.mesh, spec)
-        out = jax.make_array_from_callback(host.shape, sharding,
-                                           lambda idx: host[idx])
+        try:
+            # device_put reshards without a host round trip when the input
+            # is already device-resident (the per-tree grad/hess path)
+            out = jax.device_put(arr, sharding)
+        except Exception:
+            host = np.asarray(arr)
+            out = jax.make_array_from_callback(host.shape, sharding,
+                                               lambda idx: host[idx])
         if key is not None:
             # keep the source alive so id() stays unique; bounded so a
             # long-lived process training over many Datasets doesn't pin
