@@ -93,13 +93,14 @@ def run_at_scale(rows, args, hist_method="auto"):
     if n_valid > 0:
         t0 = time.time()
         score = booster.predict(Xv, raw_score=True)
-        order = np.argsort(score, kind="mergesort")
-        ys = yv[order]
-        npos = ys.sum()
-        nneg = len(ys) - npos
+        # Mann-Whitney AUC with midranks (tied scores are common: raw
+        # scores are sums of discrete leaf values)
+        from scipy.stats import rankdata
+        npos = yv.sum()
+        nneg = len(yv) - npos
         if npos > 0 and nneg > 0:
-            ranks = np.arange(1, len(ys) + 1)
-            auc = float((ranks[ys > 0].sum() - npos * (npos + 1) / 2)
+            ranks = rankdata(score, method="average")
+            auc = float((ranks[yv > 0].sum() - npos * (npos + 1) / 2)
                         / (npos * nneg))
         phases["valid_auc_predict"] = time.time() - t0
     return sec_per_iter, phases, auc, max(args.rounds, done)

@@ -39,6 +39,10 @@ from .data_parallel import make_mesh
 PARALLEL_MODES = ("data", "feature", "voting")
 
 
+def _pad_cols(b, *, f_pad):
+    return jnp.pad(b, ((0, 0), (0, f_pad)))
+
+
 def _pad_rows(n_pad, *arrays):
     out = []
     for a in arrays:
@@ -160,6 +164,64 @@ class ParallelGrower:
                  bundle_meta=None, forced_splits=None, **grow_kwargs):
         n, f = bins.shape
         d = self.ndev
+        # pre-partitioned mode (distributed.load_partitioned): bins is
+        # already a GLOBAL row-sharded array and grad/hess/mask arrive as
+        # this process's LOCAL row slice
+        pre_part = (isinstance(bins, jax.Array)
+                    and not bins.is_fully_addressable)
+        if pre_part:
+            assert self.mode in ("data", "voting"), (
+                "pre-partitioned datasets shard rows; use data/voting")
+            assert n % d == 0, (n, d)   # load_partitioned pads rows
+            if binsT is not None or bundle_meta is not None \
+                    or forced_splits is not None:
+                raise NotImplementedError(
+                    "binsT/EFB bundles/forced splits are not supported with "
+                    "pre-partitioned datasets yet")
+            # grad/hess/mask arrive as this process's TRUE local rows; pad
+            # to the per-process shard size with zero mass
+            loc_target = n // max(jax.process_count(), 1)
+            row = P(self.axis)
+            sharding = jax.sharding.NamedSharding(self.mesh, row)
+
+            def glob(a, fill=0.0):
+                a = np.asarray(a)
+                if a.shape[0] < loc_target:
+                    a = np.pad(a, (0, loc_target - a.shape[0]),
+                               constant_values=fill)
+                return jax.make_array_from_process_local_data(sharding, a)
+
+            grad = glob(grad)
+            hess = glob(hess)
+            sample_mask = glob(sample_mask)
+            f_pad = (-f) % d if self.mode == "data" else 0
+            if f_pad:
+                meta = _pad_features(meta, f_pad)
+                feature_mask = jnp.pad(feature_mask, (0, f_pad))
+                missing_bin = jnp.pad(missing_bin, (0, f_pad),
+                                      constant_values=-1)
+                hit = self._global_arrays.get(id(bins))
+                if hit is not None and hit[0] is bins:
+                    padded = hit[1]
+                else:
+                    pad_sharding = jax.sharding.NamedSharding(
+                        self.mesh, P(self.axis, None))
+                    padded = jax.jit(
+                        functools.partial(_pad_cols, f_pad=f_pad),
+                        out_shardings=pad_sharding)(bins)
+                    self._global_arrays[id(bins)] = (bins, padded)
+                bins = padded
+            if rng_key is None:
+                rng_key = jax.random.PRNGKey(0)
+            key = ("prepart", tuple(sorted(grow_kwargs.items())))
+            shard = self._cache.get(key)
+            if shard is None:
+                shard = self._build({}, tuple(sorted(grow_kwargs.items())))
+                self._cache[key] = shard
+            tree, leaf_id, aux = shard(bins, grad, hess, sample_mask, meta,
+                                       params, feature_mask, missing_bin,
+                                       {}, rng_key)
+            return tree, leaf_id, aux
         # pre-padding originals key the multi-process globalization cache
         # (padding allocates fresh arrays every call)
         orig_bins, orig_binsT = bins, binsT
