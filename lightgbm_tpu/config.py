@@ -303,6 +303,8 @@ class Config:
     # reference's leaf-wise order (one histogram round per split).
     tree_growth_mode: str = "batched"
     histogram_method: str = "auto"                  # auto|scatter|binloop|onehot|onehot_hilo|pallas|pallas_hilo
+    tile_leaves: int = 0                            # hist tile width (0 = auto: 42)
+    hist_block: int = 0                             # hist row-block size (0 = auto per method)
 
     def __post_init__(self):
         if self.seed is not None:

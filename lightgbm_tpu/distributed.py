@@ -52,8 +52,11 @@ def _jax_already_initialized() -> bool:
             return bool(probe())
         except Exception:
             pass
-    from jax._src import distributed as jax_dist
-    return jax_dist.global_state.client is not None
+    try:
+        from jax._src import distributed as jax_dist
+        return jax_dist.global_state.client is not None
+    except Exception:
+        return False
 
 
 def _local_addresses() -> set:
@@ -242,10 +245,12 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
         gathered = multihost_utils.process_allgather(sample_local)
         valid = multihost_utils.process_allgather(valid_local).reshape(-1)
         sample = gathered.reshape(-1, f)[valid]
-        n_global = int(multihost_utils.process_allgather(
-            np.asarray([n_local])).sum())
+        local_counts = multihost_utils.process_allgather(
+            np.asarray([n_local]))
+        n_global = int(local_counts.sum())
     else:
         sample = sample_local[valid_local]
+        local_counts = np.asarray([[n_local]])
         n_global = n_local
 
     ds = Dataset(X, label=label, weight=weight, init_score=init_score,
@@ -284,11 +289,7 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
     # excluded from histograms by the zero-padded sample mask the grower
     # applies
     n_loc_dev = jax.local_device_count()
-    if nproc > 1:
-        max_local = int(multihost_utils.process_allgather(
-            np.asarray([n_local])).max())
-    else:
-        max_local = n_local
+    max_local = int(np.max(local_counts))
     target = -(-max_local // n_loc_dev) * n_loc_dev
     if target > n_local:
         local_bins = np.pad(local_bins, ((0, target - n_local), (0, 0)))

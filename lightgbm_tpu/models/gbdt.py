@@ -95,6 +95,12 @@ class GBDT:
     # ------------------------------------------------------------ setup
     def _init_train(self, train_set: Dataset) -> None:
         train_set.construct()
+        if getattr(train_set, "is_pre_partitioned", False):
+            log.fatal("Booster-level training over a pre-partitioned "
+                      "Dataset is not supported yet: scores/labels are "
+                      "process-local while rows are globally sharded. Use "
+                      "ParallelGrower directly (see "
+                      "distributed.load_partitioned docs)")
         cfg = self.config
         self._setup_learner_features(train_set)
         if cfg.linear_tree and self.name in ("dart", "rf"):
@@ -500,6 +506,8 @@ class GBDT:
                 forced_splits=self._forced_splits,
                 max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
                 max_depth=cfg.max_depth, hist_method=hm,
+                tile_leaves=cfg.tile_leaves,
+                hist_block=cfg.hist_block,
                 exact=cfg.tree_growth_mode == "exact",
                 with_categorical=ts.has_categorical,
                 with_monotone=self._with_monotone,
@@ -513,6 +521,8 @@ class GBDT:
             ts.feature_meta, self.split_params, fmask, ts.missing_bin,
             max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
             max_depth=cfg.max_depth, hist_method=hm,
+            tile_leaves=cfg.tile_leaves,
+            hist_block=cfg.hist_block,
             binsT=ts.bins_T if hm.startswith(("onehot", "pallas")) else None,
             sub_idx=sub[0] if sub else None,
             sub_bins=sub[1] if sub else None,

@@ -277,7 +277,8 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
                      "exact", "axis_name", "with_categorical", "with_monotone",
                      "mono_mode", "mono_features",
                      "with_interactions", "cegb_mode", "extra_trees",
-                     "use_bynode", "tile_leaves", "hist_subtraction",
+                     "use_bynode", "tile_leaves", "hist_block",
+                     "hist_subtraction",
                      "feature_axis_name", "feature_shards", "voting",
                      "vote_top_k", "hist_dp"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -305,7 +306,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sub_idx: jax.Array | None = None,
               sub_bins: jax.Array | None = None,
               sub_binsT: jax.Array | None = None,
-              tile_leaves: int = 42,
+              tile_leaves: int = 0,
+              hist_block: int = 0,
               hist_subtraction: bool = True,
               feature_axis_name: str | None = None,
               feature_shards: int = 1,
@@ -376,6 +378,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     """
     n, f = bins.shape
     L = max_leaves
+    tile_leaves = tile_leaves or 42     # 0 = auto
     P = min(tile_leaves, L) if hist_method.startswith(("onehot", "pallas")) \
         else L
     cat_words = max(1, -(-num_bins // 32))
@@ -612,7 +615,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         hist_leaf_ids = state.leaf_id_sub if use_subset else state.leaf_id
         tile = histogram_tiles(bins_h, stats, hist_leaf_ids, sel, num_bins,
                                method=hist_method, dtype=hist_dtype,
-                               binsT=binsT_h)
+                               binsT=binsT_h, block=hist_block)
         if dp_scatter:
             # the reference DP learner reduce-scatters histograms so each
             # machine receives only its owned features' global sums

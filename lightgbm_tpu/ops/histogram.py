@@ -132,7 +132,7 @@ def resolve_method(method: str) -> str:
 
 def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
                     sel: jax.Array, num_bins: int, method: str = "onehot",
-                    block: int = 16384, dtype=jnp.float32,
+                    block: int = 0, dtype=jnp.float32,
                     binsT: jax.Array | None = None) -> jax.Array:
     """Histograms for a TILE of leaves.
 
@@ -168,12 +168,13 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
             fn = (pallas_hist.histogram_tiles_pallas_hilo
                   if method == "pallas_hilo"
                   else pallas_hist.histogram_tiles_pallas)
-            return fn(binsT, stats, leaf_ids, sel, num_bins)
+            return fn(binsT, stats, leaf_ids, sel, num_bins,
+                      block=block or 2048)
         method = "onehot_hilo" if method == "pallas_hilo" else "onehot"
 
     if method in ("onehot", "onehot_hilo"):
         hilo = method == "onehot_hilo" and dtype == jnp.float32
-        c = min(block, _round_up(max(n, 1), 512))
+        c = min(block or 16384, _round_up(max(n, 1), 512))
         pad = _round_up(n, c) - n
         if pad:
             bins = jnp.pad(bins, ((0, pad), (0, 0)))

@@ -209,6 +209,9 @@ class ParallelGrower:
                     padded = jax.jit(
                         functools.partial(_pad_cols, f_pad=f_pad),
                         out_shardings=pad_sharding)(bins)
+                    if len(self._global_arrays) >= 64:
+                        self._global_arrays.pop(
+                            next(iter(self._global_arrays)))
                     self._global_arrays[id(bins)] = (bins, padded)
                 bins = padded
             if rng_key is None:

@@ -1,0 +1,73 @@
+"""Sweep tile_leaves x hist_block on the real chip (the analog of the
+reference's col-vs-row auto benchmark, dataset.cpp:591-689
+TestMultiThreadingMethod, run offline instead of at startup).
+
+Usage: python scripts/tune_hist.py [--rows 2000000] [--iters 5]
+Prints sec/iter per (tile_leaves, hist_block, method) combo; feed the winner
+back via params {"tile_leaves": ..., "hist_block": ...} or update the
+defaults in models/grower.py / ops/pallas_hist.py.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=2_000_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--num-leaves", type=int, default=255)
+    ap.add_argument("--max-bin", type=int, default=255)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--methods", type=str, default="pallas_hilo,onehot")
+    ap.add_argument("--tiles", type=str, default="21,42")
+    ap.add_argument("--blocks", type=str, default="1024,2048,4096")
+    args = ap.parse_args()
+
+    import jax
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    n, f = args.rows, args.features
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + rng.logistic(size=n) > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": args.max_bin,
+                                         "verbosity": -1})
+    ds.construct()
+    print(f"# device={jax.devices()[0]} rows={n}")
+
+    results = {}
+    for method in args.methods.split(","):
+        for tile in (int(t) for t in args.tiles.split(",")):
+            for block in (int(b) for b in args.blocks.split(",")):
+                booster = lgb.Booster(params={
+                    "objective": "binary", "num_leaves": args.num_leaves,
+                    "max_bin": args.max_bin, "histogram_method": method,
+                    "tile_leaves": tile, "hist_block": block,
+                    "min_data_in_leaf": 100, "verbosity": -1,
+                }, train_set=ds)
+                try:
+                    booster.update()          # compile
+                    booster.update()
+                    _ = float(booster._boosting.train_score[0])
+                    t0 = time.time()
+                    for _ in range(args.iters):
+                        booster.update()
+                    _ = float(booster._boosting.train_score[0])
+                    dt = (time.time() - t0) / args.iters
+                    results[(method, tile, block)] = dt
+                    print(f"{method:12s} tile={tile:3d} block={block:5d}: "
+                          f"{dt:8.3f} s/iter")
+                except Exception as e:
+                    print(f"{method:12s} tile={tile:3d} block={block:5d}: "
+                          f"FAILED {type(e).__name__}: {str(e)[:120]}")
+
+    if results:
+        best = min(results, key=results.get)
+        print(f"# best: {best} ({results[best]:.3f} s/iter)")
+
+
+if __name__ == "__main__":
+    main()

@@ -80,7 +80,8 @@ def _free_port():
     return port
 
 
-def _run_procs(nproc, devices_per_proc, timeout=420):
+def _run_procs(nproc, devices_per_proc, timeout=420, src=None):
+    src = _CHILD if src is None else src
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -93,7 +94,7 @@ def _run_procs(nproc, devices_per_proc, timeout=420):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(
-        [sys.executable, "-c", _CHILD, str(port), str(r), str(nproc)],
+        [sys.executable, "-c", src, str(port), str(r), str(nproc)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for r in range(nproc)]
     outs = []
@@ -195,8 +196,8 @@ def test_pre_partitioned_loading_parity():
     grow the same tree as 1 process holding everything — the analog of the
     reference's pre-partitioned loading + distributed bin finding
     (dataset_loader.cpp:843, :1046-1128)."""
-    r2 = _run_procs_src(_CHILD_PREPART, 2, 4)
-    r1 = _run_procs_src(_CHILD_PREPART, 1, 8)
+    r2 = _run_procs(2, 4, src=_CHILD_PREPART)
+    r1 = _run_procs(1, 8, src=_CHILD_PREPART)
     # identical mappers on both ranks (distributed bin finding agreement)
     assert r2[0]["mappers_digest"] == r2[1]["mappers_digest"]
     # and the same tree as the single-process full-data run
@@ -206,34 +207,3 @@ def test_pre_partitioned_loading_parity():
                                rtol=1e-5, atol=1e-7)
 
 
-def _run_procs_src(src, nproc, devices_per_proc, timeout=420):
-    port = _free_port()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = [t for t in env.get("XLA_FLAGS", "").split()
-             if "xla_force_host_platform_device_count" not in t]
-    flags.append(
-        f"--xla_force_host_platform_device_count={devices_per_proc}")
-    env["XLA_FLAGS"] = " ".join(flags)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, "-c", src, str(port), str(r), str(nproc)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        text=True) for r in range(nproc)]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-    results = []
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out[-3000:]
-        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
-        assert line, out[-3000:]
-        results.append(json.loads(line[-1][len("RESULT "):]))
-    return results
