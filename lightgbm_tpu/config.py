@@ -297,12 +297,11 @@ class Config:
 
     # TPU-specific (new; no reference analog)
     mesh_shape: Optional[Dict[str, int]] = None     # e.g. {"data": 8}
-    hist_block_rows: int = 16384                    # row-block for histogram matmul
     # "batched": all available splits per histogram round (fast, see
     # models/grower.py docstring); "exact": strict best-first like the
     # reference's leaf-wise order (one histogram round per split).
     tree_growth_mode: str = "batched"
-    histogram_method: str = "auto"                  # auto|scatter|binloop|onehot|onehot_hilo|pallas|pallas_hilo
+    histogram_method: str = "auto"                  # auto|scatter|binloop|onehot|onehot_hilo|onehot_q8|pallas|pallas_hilo|pallas_q8
     tile_leaves: int = 0                            # hist tile width (0 = auto: 42)
     hist_block: int = 0                             # hist row-block size (0 = auto per method)
 

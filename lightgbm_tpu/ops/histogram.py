@@ -157,22 +157,29 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
     p = sel.shape[0]
     s = stats.shape[1]
 
-    if method in ("pallas", "pallas_hilo"):
+    if method in ("pallas", "pallas_hilo", "pallas_q8"):
         # the fused kernel needs: real TPU lowering, the feature-major bin
         # matrix, f32 accumulation, and the tile x stat channels within one
         # 128-lane group; otherwise run the XLA onehot formulation of the
         # same contraction
         from . import pallas_hist
         if (jax.default_backend() == "tpu" and binsT is not None
-                and dtype == jnp.float32 and p * s <= 128):
-            fn = (pallas_hist.histogram_tiles_pallas_hilo
-                  if method == "pallas_hilo"
-                  else pallas_hist.histogram_tiles_pallas)
-            return fn(binsT, stats, leaf_ids, sel, num_bins,
-                      block=block or 2048)
-        method = "onehot_hilo" if method == "pallas_hilo" else "onehot"
+                and (dtype == jnp.float32 or method == "pallas_q8")
+                and p * s <= 128):
+            kmode = {"pallas": "highest", "pallas_hilo": "hilo",
+                     "pallas_q8": "q8"}[method]
+            return pallas_hist.histogram_tiles_pallas_mode(
+                binsT, stats, leaf_ids, sel, num_bins,
+                block=block or 2048, mode=kmode)
+        method = {"pallas": "onehot", "pallas_hilo": "onehot_hilo",
+                  "pallas_q8": "onehot_q8"}[method]
 
-    if method in ("onehot", "onehot_hilo"):
+    if method in ("onehot", "onehot_hilo", "onehot_q8"):
+        # "onehot_q8": int8 MXU contraction for QUANTIZED stats (the
+        # opt-in quantized-gradient mode, see grower.py): stats arrive as
+        # int8 channels, the one-hot is exact in int8, products accumulate
+        # in int32 — exact integer histograms the caller dequantizes
+        q8 = method == "onehot_q8"
         hilo = method == "onehot_hilo" and dtype == jnp.float32
         c = min(block or 16384, _round_up(max(n, 1), 512))
         pad = _round_up(n, c) - n
@@ -186,6 +193,13 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
         def body(acc, xs):
             b, st, lid = xs
             oh_bool = (b.astype(jnp.int32)[:, :, None] == iota_b[None, None, :])
+            if q8:
+                oh = oh_bool.astype(jnp.int8).reshape(c, f * num_bins)
+                rhs = jnp.where((lid[:, None] == sel[None, :])[:, :, None],
+                                st[:, None, :], jnp.int8(0)).reshape(c, p * s)
+                h = jax.lax.dot_general(oh, rhs, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.int32)
+                return acc + h, None
             lo = (lid[:, None] == sel[None, :]).astype(dtype)  # [C, P]
             rhs = (lo[:, :, None] * st.astype(dtype)[:, None, :]
                    ).reshape(c, p * s)
@@ -218,8 +232,9 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
                                         preferred_element_type=dtype)
             return acc + h, None
 
+        acc_dtype = jnp.int32 if q8 else dtype
         h, _ = jax.lax.scan(
-            body, jnp.zeros((f * num_bins, p * s), dtype),
+            body, jnp.zeros((f * num_bins, p * s), acc_dtype),
             (bins.reshape(nblk, c, f), stats.reshape(nblk, c, s),
              leaf_ids.reshape(nblk, c)))
         return h.reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)

@@ -39,38 +39,41 @@ import jax.numpy as jnp
 _PAD = 128          # lane width; P*S channels are padded up to this
 
 
-def _hist_kernel(binsT_ref, rhs_ref, out_ref, *, f, b, c, hilo):
+def _hist_kernel(binsT_ref, rhs_ref, out_ref, *, f, b, c, mode):
     from jax.experimental import pallas as pl
 
     @pl.when(pl.program_id(0) == 0)
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    rhs = rhs_ref[...]                    # [C, 2*PAD] bf16 or [C, PAD] f32
+    rhs = rhs_ref[...]     # [C, 2*PAD] bf16 | [C, PAD] f32 | [C, PAD] int8
     binsT = binsT_ref[...]                               # [F, C] int8
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (c, b), 1)
-    oh_dtype = jnp.bfloat16 if hilo else jnp.float32
-    prec = None if hilo else jax.lax.Precision.HIGHEST
+    oh_dtype = {"hilo": jnp.bfloat16, "highest": jnp.float32,
+                "q8": jnp.int8}[mode]
+    acc_dtype = jnp.int32 if mode == "q8" else jnp.float32
+    prec = jax.lax.Precision.HIGHEST if mode == "highest" else None
     for j in range(f):                                   # static unroll
         col = binsT[j, :].astype(jnp.int32)              # [C]
         oh = (col[:, None] == iota_b).astype(oh_dtype)   # [C, B] in VMEM
         acc = jax.lax.dot_general(
             oh, rhs, (((0,), (0,)), ((), ())), precision=prec,
-            preferred_element_type=jnp.float32)
-        if hilo:
+            preferred_element_type=acc_dtype)
+        if mode == "hilo":
             acc = acc[:, :_PAD] + acc[:, _PAD:]          # recombine halves
         out_ref[j * b:(j + 1) * b, :] += acc
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "block", "hilo"))
-def _hist_pallas_call(binsT, rhs, *, num_bins, block, hilo):
+@functools.partial(jax.jit, static_argnames=("num_bins", "block", "mode"))
+def _hist_pallas_call(binsT, rhs, *, num_bins, block, mode):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     f, n = binsT.shape
     c = block
     nblk = n // c
-    w = 2 * _PAD if hilo else _PAD
-    kernel = functools.partial(_hist_kernel, f=f, b=num_bins, c=c, hilo=hilo)
+    w = 2 * _PAD if mode == "hilo" else _PAD
+    out_dtype = jnp.int32 if mode == "q8" else jnp.float32
+    kernel = functools.partial(_hist_kernel, f=f, b=num_bins, c=c, mode=mode)
     return pl.pallas_call(
         kernel,
         grid=(nblk,),
@@ -79,15 +82,15 @@ def _hist_pallas_call(binsT, rhs, *, num_bins, block, hilo):
             pl.BlockSpec((c, w), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((f * num_bins, _PAD), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f * num_bins, _PAD), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((f * num_bins, _PAD), out_dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
     )(binsT, rhs)
 
 
-def _prep_rhs(binsT, stats, leaf_ids, sel, block):
-    """Shared prep: pad rows to the block size and build the f32
-    leaf-onehot x stat channel matrix [N, _PAD]."""
+def _prep_rhs(binsT, stats, leaf_ids, sel, block, q8=False):
+    """Shared prep: pad rows to the block size and build the leaf-onehot x
+    stat channel matrix [N, _PAD] (f32, or int8 for the q8 mode)."""
     f, n = binsT.shape
     p = sel.shape[0]
     s = stats.shape[1]
@@ -98,9 +101,13 @@ def _prep_rhs(binsT, stats, leaf_ids, sel, block):
         binsT = jnp.pad(binsT, ((0, 0), (0, pad)))
         stats = jnp.pad(stats, ((0, pad), (0, 0)))
         leaf_ids = jnp.pad(leaf_ids, (0, pad), constant_values=-1)
-    lo = (leaf_ids[:, None] == sel[None, :]).astype(jnp.float32)   # [N, P]
-    rhs = (lo[:, :, None] * stats.astype(jnp.float32)[:, None, :]
-           ).reshape(-1, p * s)
+    lo = leaf_ids[:, None] == sel[None, :]                         # [N, P]
+    if q8:
+        rhs = jnp.where(lo[:, :, None], stats[:, None, :],
+                        jnp.int8(0)).reshape(-1, p * s)
+    else:
+        rhs = (lo.astype(jnp.float32)[:, :, None]
+               * stats.astype(jnp.float32)[:, None, :]).reshape(-1, p * s)
     rhs = jnp.pad(rhs, ((0, 0), (0, _PAD - p * s)))
     return binsT, rhs, c
 
@@ -113,16 +120,24 @@ def split_hilo(rhs: jax.Array) -> jax.Array:
     return jnp.concatenate([rhs_hi, rhs_lo], axis=1)
 
 
-def _histogram_tiles_pallas(binsT, stats, leaf_ids, sel, num_bins, block,
-                            hilo):
+def histogram_tiles_pallas_mode(binsT, stats, leaf_ids, sel, num_bins,
+                                block=2048, mode="hilo"):
+    """[P, F, B, S] histogram tile via the fused kernel.
+
+    ``mode``: "hilo" (2-pass bf16, the fast f32 default), "highest"
+    (6-pass, precise), or "q8" (int8 stats -> exact int32 histograms for
+    the quantized-gradient training mode; ~2x hilo's MXU rate).
+    Takes the FEATURE-MAJOR bin matrix [F, N].
+    """
     f = binsT.shape[0]
     p = sel.shape[0]
     s = stats.shape[1]
-    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block)
-    if hilo:
+    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block,
+                              q8=(mode == "q8"))
+    if mode == "hilo":
         rhs = split_hilo(rhs)
     out = _hist_pallas_call(binsT, rhs, num_bins=num_bins, block=c,
-                            hilo=hilo)
+                            mode=mode)
     return out[:, :p * s].reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
 
 
@@ -135,8 +150,8 @@ def histogram_tiles_pallas(binsT: jax.Array, stats: jax.Array,
     matrix [F, N] (contiguous per-feature rows for the kernel's block
     loads).
     """
-    return _histogram_tiles_pallas(binsT, stats, leaf_ids, sel, num_bins,
-                                   block, hilo=False)
+    return histogram_tiles_pallas_mode(binsT, stats, leaf_ids, sel,
+                                       num_bins, block, mode="highest")
 
 
 def histogram_tiles_pallas_hilo(binsT: jax.Array, stats: jax.Array,
@@ -144,5 +159,5 @@ def histogram_tiles_pallas_hilo(binsT: jax.Array, stats: jax.Array,
                                 num_bins: int, block: int = 2048) -> jax.Array:
     """[P, F, B, S] histogram tile via the fused kernel, hi/lo bf16 matmuls
     (the fast default — see the module docstring's precision model)."""
-    return _histogram_tiles_pallas(binsT, stats, leaf_ids, sel, num_bins,
-                                   block, hilo=True)
+    return histogram_tiles_pallas_mode(binsT, stats, leaf_ids, sel,
+                                       num_bins, block, mode="hilo")

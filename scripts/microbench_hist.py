@@ -86,6 +86,15 @@ def main():
                 lambda blk=blk: pallas_hist.histogram_tiles_pallas_hilo(
                     binsT, stats, leaf_ids, sel, b, block=blk)))
 
+    if hasattr(pallas_hist, "histogram_tiles_pallas_mode"):
+        stats_q = jnp.asarray(
+            rng.randint(-127, 128, size=(n, 3)).astype(np.int8))
+        for blk in (2048, 4096):
+            bench(f"pallas_q8_blk{blk}", jax.jit(
+                lambda blk=blk: pallas_hist.histogram_tiles_pallas_mode(
+                    binsT, stats_q, leaf_ids, sel, b, block=blk,
+                    mode="q8")))
+
     if results:
         best = min(results, key=results.get)
         print(f"# best: {best} ({results[best]*1e3:.1f} ms)")
