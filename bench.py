@@ -161,6 +161,20 @@ def main():
                           "error": "all ladder scales failed"}))
         sys.exit(1)
 
+    # secondary probe: the opt-in int8 quantized-gradient mode (timing
+    # only, short run — the headline number stays on the default path)
+    q8_sec = None
+    if used_method == "auto" and jax.default_backend() == "tpu":
+        try:
+            q8_args = argparse.Namespace(**{**vars(args), "iters": 5,
+                                            "rounds": 0, "valid_rows": 0})
+            q8_sec, _, _, _ = run_at_scale(used_rows, q8_args,
+                                           hist_method="pallas_q8")
+            print(f"# q8 probe: {q8_sec:.3f} s/iter", file=sys.stderr)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            print("# q8 probe failed; omitting", file=sys.stderr)
+
     for k, v in phases.items():
         print(f"# phase {k}: {v:.3f}s", file=sys.stderr)
 
@@ -188,6 +202,7 @@ def main():
         "auc": round(auc, 6) if auc is not None else None,
         "auc_rounds": rounds_run,
         "hist_method": used_method,
+        "q8_sec_per_iter": round(q8_sec, 4) if q8_sec is not None else None,
         "phases": {k: round(v, 3) for k, v in phases.items()},
     }))
 
