@@ -46,6 +46,26 @@ def _bagging_mask(key: jax.Array, frac, n: int) -> jax.Array:
     return (u < frac).astype(jnp.float32)
 
 
+@jax.jit
+def _linear_valid_delta(leaf: jax.Array, leaf_value: jax.Array,
+                        const: jax.Array, W: jax.Array, used: jax.Array,
+                        raw: jax.Array) -> jax.Array:
+    """Linear-leaf tree output for valid rows, on device (the device analog
+    of ModelTree.predict's linear branch: const + coeff.x, rows with
+    NaN/inf in any of their leaf's linear features fall back to the plain
+    leaf value, linear_tree_learner.cpp:19-41)."""
+    oh = jax.nn.one_hot(leaf, const.shape[0], dtype=jnp.float32)   # [N, L]
+    finite = jnp.isfinite(raw)
+    raw0 = jnp.where(finite, raw, 0.0)
+    w_row = jax.lax.dot_general(oh, W, (((1,), (0,)), ((), ())),
+                                precision=jax.lax.Precision.HIGHEST)
+    contrib = jnp.sum(w_row * raw0, axis=1)
+    used_row = jax.lax.dot_general(oh, used, (((1,), (0,)), ((), ())),
+                                   precision=jax.lax.Precision.HIGHEST)
+    bad = jnp.sum(used_row * (~finite).astype(jnp.float32), axis=1) > 0
+    return jnp.where(bad, leaf_value[leaf], const[leaf] + contrib)
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def _bagging_subset(key: jax.Array, bins: jax.Array, k: int):
     """Exact-k bagging selection + subset copy (gbdt.cpp:810-818 /
@@ -83,6 +103,7 @@ class GBDT:
         self.loaded = None
         self.loaded_iters = 0
         self._mt_cache: Dict[int, object] = {}   # host-tree idx -> ModelTree
+        self._valid_raw_cache: Dict[int, jax.Array] = {}
         self._stacked_cache: Optional[Tuple[int, TreeArrays]] = None
         self.valid_sets: List[Dataset] = []
         self.valid_names: List[str] = []
@@ -647,13 +668,47 @@ class GBDT:
             ht.leaf_const = linear["const"] * lr
             ht.leaf_coeff = [[c * lr for c in cs] for cs in linear["coeff"]]
             ht.leaf_features_raw = linear["features"]
+        lin_tables = None
         mt = None
         if linear is not None and self.valid_sets:
-            from ..io.model_text import ModelTree
-            mt = ModelTree.from_host(self.host_trees[-1],
-                                     self.train_set.mappers)
+            ht = self.host_trees[-1]
+            if all(getattr(vs, "raw_data_np", None) is not None
+                   for vs in self.valid_sets):
+                # device tables for linear-leaf valid scoring: dense
+                # [L, F_total] coefficient matrix + used-feature mask so
+                # per-iteration valid deltas stay on device (no host tree
+                # walk per valid set per tree)
+                # tables padded to the CONFIG leaf budget so the jitted
+                # delta kernel compiles once, not per distinct tree size
+                L = self.config.num_leaves
+                nl = len(ht.leaf_value)
+                ftot = self.train_set.num_total_features
+                W = np.zeros((L, ftot), np.float32)
+                used = np.zeros((L, ftot), np.float32)
+                for li, (feats, coefs) in enumerate(
+                        zip(ht.leaf_features_raw, ht.leaf_coeff)):
+                    for fj, cj in zip(feats, coefs):
+                        W[li, int(fj)] = np.float32(cj)
+                        used[li, int(fj)] = 1.0
+                lv = np.zeros((L,), np.float32)
+                lv[:nl] = np.asarray(ht.leaf_value, np.float32)
+                lc = np.zeros((L,), np.float32)
+                lc[:nl] = np.asarray(ht.leaf_const, np.float32)
+                lin_tables = (jnp.asarray(lv), jnp.asarray(lc),
+                              jnp.asarray(W), jnp.asarray(used))
+            else:
+                from ..io.model_text import ModelTree
+                mt = ModelTree.from_host(ht, self.train_set.mappers)
         for i, vs in enumerate(self.valid_sets):
-            if mt is not None:
+            if lin_tables is not None:
+                raw_dev = self._valid_raw_cache.get(i)
+                if raw_dev is None:
+                    raw_dev = jnp.asarray(
+                        vs.raw_data_np.astype(np.float32, copy=False))
+                    self._valid_raw_cache[i] = raw_dev
+                leaf = predict_leaf_bins(tree, vs.bins, vs.missing_bin)
+                vdelta = _linear_valid_delta(leaf, *lin_tables, raw_dev)
+            elif mt is not None:
                 vdelta = jnp.asarray(mt.predict(vs.raw_data_np).astype(np.float32))
             else:
                 vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin)

@@ -101,3 +101,30 @@ def test_linear_tree_rejects_dart():
     with pytest.raises(LightGBMError):
         lgb.train(lin_p, lgb.Dataset(X, label=y, params=lin_p,
                                      free_raw_data=False), num_boost_round=2)
+
+
+def test_linear_valid_scoring_device_matches_predict():
+    """Per-iteration valid-set scoring for linear-leaf trees runs on device
+    (dense coefficient tables) and must agree with the host predict path
+    used for final predictions."""
+    rng = np.random.RandomState(41)
+    n = 1500
+    X = rng.uniform(-2, 2, size=(n, 4))
+    X[rng.uniform(size=X.shape) < 0.03] = np.nan   # exercise the fallback
+    y = 2.0 * np.nan_to_num(X[:, 0]) + np.sin(np.nan_to_num(X[:, 1])) \
+        + 0.1 * rng.normal(size=n)
+    Xv, yv = X[:400].copy(), y[:400]
+    params = {"objective": "regression", "num_leaves": 15,
+              "linear_tree": True, "metric": ["l2"], "verbosity": -1}
+    train = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    evals = {}
+    booster = lgb.train(params, train, num_boost_round=8,
+                        valid_sets=[valid], valid_names=["v"],
+                        evals_result=evals)
+    # the recorded per-iteration metric must match an l2 computed from the
+    # final prediction path (host ModelTree walk)
+    pred = booster.predict(Xv)
+    l2_direct = float(np.mean((pred - yv) ** 2))
+    l2_recorded = evals["v"]["l2"][-1]
+    np.testing.assert_allclose(l2_recorded, l2_direct, rtol=1e-4)
