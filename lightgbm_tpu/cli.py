@@ -137,8 +137,161 @@ def _load_binary(path: str):
             opt(z["init_score"]))
 
 
+def _iter_parsed_chunks(path: str, config: Config,
+                        chunk_bytes: int = 64 << 20):
+    """Stream a text file in line-aligned chunks, parsing each with the
+    native parser (the streaming half of the reference's two-round loading,
+    dataset_loader.cpp:225-244 + pipeline_reader.h)."""
+    from .native import parse_buffer
+    carry = b""
+    first = True
+    with open(path, "rb") as fh:
+        while True:
+            blk = fh.read(chunk_bytes)
+            if not blk:
+                if carry.strip():
+                    yield parse_buffer(carry, has_header=False,
+                                       num_threads=config.num_threads)[0]
+                return
+            blk = carry + blk
+            cut = blk.rfind(b"\n")
+            if cut < 0:
+                carry = blk
+                continue
+            chunk, carry = blk[:cut + 1], blk[cut + 1:]
+            if first and config.header:
+                chunk = chunk[chunk.find(b"\n") + 1:]
+            first = False
+            if chunk.strip():
+                yield parse_buffer(chunk, has_header=False,
+                                   num_threads=config.num_threads)[0]
+
+
+def load_dataset_two_round(path: str, config: Config,
+                           params: Dict[str, str]) -> Optional[Dataset]:
+    """Two-round low-memory loading (reference: dataset_loader.cpp:225-244
+    use_two_round_loading): round 1 streams the file collecting the label/
+    weight/group columns and a row sample for bin finding; round 2 streams
+    again, binning each chunk against the fitted mappers — the full raw
+    feature matrix is never resident (peak memory = the 1-byte bin matrix
+    plus one parsed chunk)."""
+    from . import binning
+    # chunked parsing needs a fixed column count per line; LibSVM's sparse
+    # rows make per-chunk column inference unstable -> in-memory fallback
+    with open(path) as fh:
+        if config.header:
+            fh.readline()
+        first = fh.readline()
+    tok = first.split()
+    if any(":" in t for t in tok[1:2] + tok[-1:]):
+        log.warning("two_round loading supports CSV/TSV only; "
+                    "falling back to in-memory loading for LibSVM input")
+        return None
+    header_names = _read_header(path, config)
+    label_idx = _column_index(config.label_column, header_names)
+    if label_idx is None:
+        label_idx = 0
+    weight_idx = _column_index(config.weight_column, header_names)
+    group_idx = _column_index(config.group_column, header_names)
+    drop = {label_idx}
+    if config.ignore_column:
+        for part in str(config.ignore_column).split(","):
+            idx = _column_index(part, header_names)
+            if idx is not None:
+                drop.add(idx)
+    if weight_idx is not None:
+        drop.add(weight_idx)
+    if group_idx is not None:
+        drop.add(group_idx)
+
+    # round 1: labels/metadata + reservoir sample of feature rows
+    # (algorithm R, seeded — the analog of the reference's Random::Sample
+    # over the stream)
+    rng = np.random.RandomState(config.data_random_seed)
+    cap = config.bin_construct_sample_cnt
+    sample_rows: List[np.ndarray] = []
+    ys, ws, gs = [], [], []
+    keep = None
+    n_total = 0
+    for mat in _iter_parsed_chunks(path, config):
+        if keep is None:
+            keep = [j for j in range(mat.shape[1]) if j not in drop]
+        ys.append(mat[:, label_idx].copy())
+        if weight_idx is not None:
+            ws.append(mat[:, weight_idx].copy())
+        if group_idx is not None:
+            gs.append(mat[:, group_idx].copy())
+        Xc = mat[:, keep]
+        m = Xc.shape[0]
+        take = min(max(cap - n_total, 0), m)
+        for r in range(take):               # filling phase
+            sample_rows.append(Xc[r].copy())
+        if take < m:
+            # vectorized reservoir (algorithm R) for the rest of the chunk
+            draws = rng.randint(0, n_total + np.arange(take, m) + 1)
+            hit = np.nonzero(draws < cap)[0]
+            for r in hit:
+                sample_rows[draws[r]] = Xc[take + r].copy()
+        n_total += m
+    if keep is None:
+        log.fatal(f"empty data file {path}")
+    y = np.concatenate(ys)
+    sample = np.asarray(sample_rows)
+
+    names = ([header_names[j] for j in keep] if header_names
+             else [f"Column_{i}" for i in range(len(keep))])
+    ds = Dataset(None, label=y, params=dict(params), feature_name=names)
+    cats = ds._resolve_categorical(len(keep), names)
+    cat_set = set(int(c) for c in cats)
+    from .basic import _load_forced_bins
+    forced = _load_forced_bins(config, len(keep), cats)
+    filter_cnt = binning.filter_cnt_for_sample(config, len(sample), n_total)
+    ds.mappers = [binning.fit_mapper_for_column(
+        j, np.asarray(sample[:, j], np.float64), len(sample), config,
+        cat_set, filter_cnt, forced) for j in range(len(keep))]
+    ds.used_features = np.array(
+        [j for j, m in enumerate(ds.mappers) if not m.is_trivial], np.int32)
+    ds.num_data = n_total
+    ds.num_total_features = len(keep)
+    ds._feature_names = names
+    ds.bundles = None
+    ds._build_feature_meta(config)
+
+    # round 2: bin chunk by chunk against the agreed mappers
+    used = [ds.mappers[j] for j in ds.used_features]
+    dtype = np.uint8 if ds.max_num_bins <= 256 else np.int32
+    bins_np = np.zeros((n_total, max(len(ds.used_features), 1)), dtype)
+    if used:
+        row = 0
+        for mat in _iter_parsed_chunks(path, config):
+            Xc = mat[:, keep][:, ds.used_features]
+            bins_np[row:row + mat.shape[0]] = binning.bin_data(Xc, used)
+            row += mat.shape[0]
+    import jax.numpy as jnp
+    ds.bins = jnp.asarray(bins_np)
+    ds.raw_data_np = None
+    ds._constructed = True
+
+    weight = np.concatenate(ws) if ws else _side_file(path, ".weight")
+    group = _side_file(path, ".query")
+    if group is None and gs:
+        _, counts = np.unique(np.concatenate(gs), return_counts=True)
+        group = counts
+    ds.weight = weight
+    ds.group = group
+    ds.init_score = _side_file(path, ".init")
+    log.info(f"two-round loading: {n_total} rows, "
+             f"{len(ds.used_features)} used features")
+    return ds
+
+
 def _make_dataset(path: str, config: Config, params: Dict[str, str],
                   reference: Optional[Dataset] = None) -> Dataset:
+    if config.two_round and reference is None \
+            and not path.endswith(".bin"):
+        ds = load_dataset_two_round(path, config, params)
+        if ds is not None:
+            return ds
     X, y, weight, group, init_score = load_data_file(path, config)
     return Dataset(X, label=y, weight=weight, group=group,
                    init_score=init_score, reference=reference, params=params,

@@ -102,6 +102,38 @@ def parse_text_file(path: str, has_header: bool = False,
         lib.ltp_free(handle)
 
 
+def parse_buffer(data: bytes, has_header: bool = False,
+                 num_threads: int = 0) -> Tuple[np.ndarray, str]:
+    """Parse an in-memory text chunk (line-aligned) into a dense float64
+    matrix — the streaming unit of two-round loading (cli.py). Falls back
+    to numpy when the native build is unavailable."""
+    lib = _load()
+    if lib is None:
+        import io
+        text = data.decode()
+        skip = 1 if has_header else 0
+        first = text.split("\n", 1)[0]
+        delim = "," if "," in first else None
+        mat = np.loadtxt(io.StringIO(text), delimiter=delim, skiprows=skip,
+                         ndmin=2)
+        return mat, ("csv" if delim == "," else "tsv")
+    handle = lib.ltp_parse_buffer(data, len(data), int(has_header),
+                                  num_threads)
+    if not handle:
+        raise ValueError("could not parse data chunk")
+    try:
+        err = lib.ltp_error(handle).decode()
+        if err:
+            raise ValueError(f"parse error in chunk: {err}")
+        rows, cols = lib.ltp_rows(handle), lib.ltp_cols(handle)
+        fmt = FMT_NAMES.get(lib.ltp_format(handle), "csv")
+        buf = np.ctypeslib.as_array(lib.ltp_data(handle),
+                                    shape=(rows, cols)).copy()
+        return buf, fmt
+    finally:
+        lib.ltp_free(handle)
+
+
 def _parse_text_file_py(path: str, has_header: bool) -> Tuple[np.ndarray, str]:
     """Pure-python fallback (slow path)."""
     with open(path) as fh:

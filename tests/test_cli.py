@@ -163,3 +163,43 @@ def test_cli_weight_side_file(workdir):
         assert os.path.exists("mw.txt")
     finally:
         os.remove("binary.train.weight")
+
+
+def test_two_round_loading_matches_in_memory(workdir):
+    """task=train with use_two_round_loading=true streams the file twice
+    (sample + bin per chunk; raw matrix never resident) and must produce
+    the same model as in-memory loading when the bin sample covers all
+    rows (reference: dataset_loader.cpp:225-244)."""
+    os.chdir(workdir)
+    common = ["task=train", "data=binary.train", "objective=binary",
+              "num_leaves=15", "num_iterations=5", "verbosity=-1",
+              "bin_construct_sample_cnt=100000"]
+    cli_main(common + ["output_model=m_mem.txt"])
+    cli_main(common + ["two_round=true", "output_model=m_2r.txt"])
+    b_mem = lgb.Booster(model_file=str(workdir / "m_mem.txt"))
+    b_2r = lgb.Booster(model_file=str(workdir / "m_2r.txt"))
+    X = np.loadtxt(str(workdir / "binary.test"))[:, 1:]
+    np.testing.assert_allclose(b_2r.predict(X), b_mem.predict(X), rtol=1e-6)
+
+
+def test_two_round_small_chunks(workdir, monkeypatch):
+    """Chunk boundaries must not change the result: force tiny chunks so
+    every code path (carry lines, many chunks) is exercised."""
+    import lightgbm_tpu.cli as cli_mod
+    os.chdir(workdir)
+    orig = cli_mod._iter_parsed_chunks
+
+    def tiny_chunks(path, config, chunk_bytes=64 << 20):
+        return orig(path, config, chunk_bytes=8192)
+
+    monkeypatch.setattr(cli_mod, "_iter_parsed_chunks", tiny_chunks)
+    common = ["task=train", "data=binary.train", "objective=binary",
+              "num_leaves=15", "num_iterations=5", "verbosity=-1",
+              "bin_construct_sample_cnt=100000"]
+    cli_main(common + ["two_round=true", "output_model=m_2r_tiny.txt"])
+    cli_main(common + ["output_model=m_mem_tiny.txt"])   # self-contained
+    b_tiny = lgb.Booster(model_file=str(workdir / "m_2r_tiny.txt"))
+    b_mem = lgb.Booster(model_file=str(workdir / "m_mem_tiny.txt"))
+    X = np.loadtxt(str(workdir / "binary.test"))[:, 1:]
+    np.testing.assert_allclose(b_tiny.predict(X), b_mem.predict(X),
+                               rtol=1e-6)
