@@ -76,35 +76,54 @@ def _side_file(path: str, suffix: str) -> Optional[np.ndarray]:
     return None
 
 
+def _resolve_columns(path: str, config: Config):
+    """Shared column resolution for both loading paths: returns
+    (header_names, label_idx, weight_idx, group_idx, drop-set)."""
+    header_names = _read_header(path, config)
+    label_idx = _column_index(config.label_column, header_names)
+    if label_idx is None:
+        label_idx = 0
+    drop = {label_idx}
+    if config.ignore_column:
+        for part in str(config.ignore_column).split(","):
+            idx = _column_index(part, header_names)
+            if idx is not None:
+                drop.add(idx)
+    weight_idx = _column_index(config.weight_column, header_names)
+    group_idx = _column_index(config.group_column, header_names)
+    if weight_idx is not None:
+        drop.add(weight_idx)
+    if group_idx is not None:
+        drop.add(group_idx)
+    return header_names, label_idx, weight_idx, group_idx, drop
+
+
+def _qid_to_group(group_col: np.ndarray) -> np.ndarray:
+    """Per-row query ids -> query boundary counts by CONSECUTIVE RUNS in
+    file order (reference: metadata.cpp query column handling — qids need
+    not be globally sorted, only grouped)."""
+    group_col = np.asarray(group_col)
+    if len(group_col) == 0:
+        return np.zeros(0, np.int64)
+    change = np.nonzero(np.diff(group_col) != 0)[0]
+    bounds = np.concatenate([[0], change + 1, [len(group_col)]])
+    return np.diff(bounds)
+
+
 def load_data_file(path: str, config: Config
                    ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
                               Optional[np.ndarray], Optional[np.ndarray]]:
     """Load one data file -> (X, y, weight, group, init_score)."""
     if path.endswith(".bin"):
         return _load_binary(path)
-    header_names = _read_header(path, config)
+    (header_names, label_idx, weight_idx, group_idx,
+     drop) = _resolve_columns(path, config)
     mat, _fmt = parse_text_file(path, has_header=config.header,
                                 num_threads=config.num_threads)
-    label_idx = _column_index(config.label_column, header_names)
-    if label_idx is None:
-        label_idx = 0
-    ignore = set()
-    if config.ignore_column:
-        for part in str(config.ignore_column).split(","):
-            idx = _column_index(part, header_names)
-            if idx is not None:
-                ignore.add(idx)
-    weight_idx = _column_index(config.weight_column, header_names)
-    group_idx = _column_index(config.group_column, header_names)
 
     y = mat[:, label_idx]
     weight = mat[:, weight_idx] if weight_idx is not None else None
     group_col = mat[:, group_idx] if group_idx is not None else None
-    drop = {label_idx} | ignore
-    if weight_idx is not None:
-        drop.add(weight_idx)
-    if group_idx is not None:
-        drop.add(group_idx)
     keep = [j for j in range(mat.shape[1]) if j not in drop]
     X = mat[:, keep]
 
@@ -112,9 +131,7 @@ def load_data_file(path: str, config: Config
         weight = _side_file(path, ".weight")
     group = _side_file(path, ".query")
     if group is None and group_col is not None:
-        # per-row query ids -> query boundaries (metadata.cpp query column)
-        _, counts = np.unique(group_col, return_counts=True)
-        group = counts
+        group = _qid_to_group(group_col)
     init_score = _side_file(path, ".init")
     return X, y, weight, group, init_score
 
@@ -145,13 +162,28 @@ def _iter_parsed_chunks(path: str, config: Config,
     from .native import parse_buffer
     carry = b""
     first = True
+    ncols = None
+
+    def emit(data):
+        nonlocal ncols
+        mat = parse_buffer(data, has_header=False,
+                           num_threads=config.num_threads)[0]
+        # the parser infers the width per buffer; ragged rows or format
+        # drift across chunk boundaries would silently corrupt columns
+        if ncols is None:
+            ncols = mat.shape[1]
+        elif mat.shape[1] != ncols:
+            log.fatal(f"two_round loading needs a fixed column count: "
+                      f"{path} yielded {mat.shape[1]} columns in a chunk "
+                      f"where earlier chunks had {ncols} (ragged rows?)")
+        return mat
+
     with open(path, "rb") as fh:
         while True:
             blk = fh.read(chunk_bytes)
             if not blk:
                 if carry.strip():
-                    yield parse_buffer(carry, has_header=False,
-                                       num_threads=config.num_threads)[0]
+                    yield emit(carry)
                 return
             blk = carry + blk
             cut = blk.rfind(b"\n")
@@ -163,8 +195,87 @@ def _iter_parsed_chunks(path: str, config: Config,
                 chunk = chunk[chunk.find(b"\n") + 1:]
             first = False
             if chunk.strip():
-                yield parse_buffer(chunk, has_header=False,
-                                   num_threads=config.num_threads)[0]
+                yield emit(chunk)
+
+
+def _two_round_eligible(path: str, config: Config) -> bool:
+    """CSV/TSV with fixed columns only; linear trees need resident raw
+    features. Ineligible files fall back to in-memory loading."""
+    if config.linear_tree:
+        log.warning("two_round is not supported with linear_tree; "
+                    "falling back to in-memory loading")
+        return False
+    # chunked parsing needs a fixed column count per line; LibSVM's sparse
+    # rows make per-chunk column inference unstable -> in-memory fallback
+    # (sniff several lines: a LibSVM file may open with label-only rows)
+    with open(path) as fh:
+        if config.header:
+            fh.readline()
+        probe = [fh.readline() for _ in range(5)]
+    if any(":" in t for line in probe for t in line.split()[1:]):
+        log.warning("two_round loading supports CSV/TSV only; "
+                    "falling back to in-memory loading for LibSVM input")
+        return False
+    return True
+
+
+def load_valid_two_round(path: str, config: Config, params: Dict[str, str],
+                         reference: Dataset) -> Optional[Dataset]:
+    """Stream-bin a VALIDATION file against the reference's mappers (the
+    second round only — mappers come from the train set; reference:
+    dataset_loader.cpp:262-314 LoadFromFileAlignWithOtherDataset under
+    two-round mode)."""
+    from . import binning
+    if getattr(reference, "bundles", None) is not None:
+        return None   # bundled references bin through bundle columns
+    if not _two_round_eligible(path, config):
+        return None
+    (header_names, label_idx, weight_idx, group_idx,
+     drop) = _resolve_columns(path, config)
+    used_idx = reference.used_features
+    used = [reference.mappers[j] for j in used_idx]
+    dtype = np.uint8 if reference.max_num_bins <= 256 else np.int32
+    ys, ws, gs, chunks = [], [], [], []
+    for mat in _iter_parsed_chunks(path, config):
+        keep = [j for j in range(mat.shape[1]) if j not in drop]
+        if len(keep) != reference.num_total_features:
+            log.fatal(f"validation file {path} has {len(keep)} features; "
+                      f"training data had "
+                      f"{reference.num_total_features}")
+        ys.append(mat[:, label_idx].copy())
+        if weight_idx is not None:
+            ws.append(mat[:, weight_idx].copy())
+        if group_idx is not None:
+            gs.append(mat[:, group_idx].copy())
+        Xc = mat[:, keep][:, used_idx] if len(used_idx) \
+            else np.zeros((mat.shape[0], 0))
+        chunk_bins = binning.bin_data(Xc, used) if used \
+            else np.zeros((mat.shape[0], 1), np.int32)
+        chunks.append(chunk_bins.astype(dtype))
+    if not chunks:
+        log.fatal(f"empty validation file {path}")
+    y = np.concatenate(ys)
+    ds = Dataset(None, label=y, params=dict(params),
+                 feature_name=list(reference._feature_names))
+    for attr in ("mappers", "used_features", "_feature_meta",
+                 "_missing_bin", "max_num_bins", "has_categorical",
+                 "bundles", "pandas_categorical"):
+        setattr(ds, attr, getattr(reference, attr, None))
+    import jax.numpy as jnp
+    ds.bins = jnp.asarray(np.concatenate(chunks))
+    ds.num_data = len(y)
+    ds.num_total_features = reference.num_total_features
+    ds._feature_names = list(reference._feature_names)
+    ds.raw_data_np = None
+    ds._constructed = True
+    ds.weight = np.concatenate(ws) if ws else _side_file(path, ".weight")
+    group = _side_file(path, ".query")
+    if group is None and gs:
+        group = _qid_to_group(np.concatenate(gs))
+    ds.group = group
+    ds.init_score = _side_file(path, ".init")
+    log.info(f"two-round valid loading: {len(y)} rows")
+    return ds
 
 
 def load_dataset_two_round(path: str, config: Config,
@@ -176,33 +287,10 @@ def load_dataset_two_round(path: str, config: Config,
     feature matrix is never resident (peak memory = the 1-byte bin matrix
     plus one parsed chunk)."""
     from . import binning
-    # chunked parsing needs a fixed column count per line; LibSVM's sparse
-    # rows make per-chunk column inference unstable -> in-memory fallback
-    with open(path) as fh:
-        if config.header:
-            fh.readline()
-        first = fh.readline()
-    tok = first.split()
-    if any(":" in t for t in tok[1:2] + tok[-1:]):
-        log.warning("two_round loading supports CSV/TSV only; "
-                    "falling back to in-memory loading for LibSVM input")
+    if not _two_round_eligible(path, config):
         return None
-    header_names = _read_header(path, config)
-    label_idx = _column_index(config.label_column, header_names)
-    if label_idx is None:
-        label_idx = 0
-    weight_idx = _column_index(config.weight_column, header_names)
-    group_idx = _column_index(config.group_column, header_names)
-    drop = {label_idx}
-    if config.ignore_column:
-        for part in str(config.ignore_column).split(","):
-            idx = _column_index(part, header_names)
-            if idx is not None:
-                drop.add(idx)
-    if weight_idx is not None:
-        drop.add(weight_idx)
-    if group_idx is not None:
-        drop.add(group_idx)
+    (header_names, label_idx, weight_idx, group_idx,
+     drop) = _resolve_columns(path, config)
 
     # round 1: labels/metadata + reservoir sample of feature rows
     # (algorithm R, seeded — the analog of the reference's Random::Sample
@@ -224,8 +312,8 @@ def load_dataset_two_round(path: str, config: Config,
         Xc = mat[:, keep]
         m = Xc.shape[0]
         take = min(max(cap - n_total, 0), m)
-        for r in range(take):               # filling phase
-            sample_rows.append(Xc[r].copy())
+        if take:                            # filling phase, vectorized
+            sample_rows.extend(list(Xc[:take].copy()))
         if take < m:
             # vectorized reservoir (algorithm R) for the rest of the chunk
             draws = rng.randint(0, n_total + np.arange(take, m) + 1)
@@ -275,8 +363,7 @@ def load_dataset_two_round(path: str, config: Config,
     weight = np.concatenate(ws) if ws else _side_file(path, ".weight")
     group = _side_file(path, ".query")
     if group is None and gs:
-        _, counts = np.unique(np.concatenate(gs), return_counts=True)
-        group = counts
+        group = _qid_to_group(np.concatenate(gs))
     ds.weight = weight
     ds.group = group
     ds.init_score = _side_file(path, ".init")
@@ -287,9 +374,11 @@ def load_dataset_two_round(path: str, config: Config,
 
 def _make_dataset(path: str, config: Config, params: Dict[str, str],
                   reference: Optional[Dataset] = None) -> Dataset:
-    if config.two_round and reference is None \
-            and not path.endswith(".bin"):
-        ds = load_dataset_two_round(path, config, params)
+    if config.two_round and not path.endswith(".bin"):
+        ds = (load_dataset_two_round(path, config, params)
+              if reference is None
+              else load_valid_two_round(path, config, params,
+                                        reference.construct()))
         if ds is not None:
             return ds
     X, y, weight, group, init_score = load_data_file(path, config)

@@ -143,7 +143,7 @@ class GammaMetric(Metric):
         b = -np.log(-theta)
         c = (1.0 / psi * np.log(self.label / psi)
              - np.log(self.label) - 0.0)  # lgamma(1/psi)=0 for psi=1
-        return self._wavg(-((self.label * theta + b) / a + c))
+        return self._wavg(-((self.label * theta - b) / a + c))
 
 
 class GammaDevianceMetric(Metric):

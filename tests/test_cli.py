@@ -203,3 +203,30 @@ def test_two_round_small_chunks(workdir, monkeypatch):
     X = np.loadtxt(str(workdir / "binary.test"))[:, 1:]
     np.testing.assert_allclose(b_tiny.predict(X), b_mem.predict(X),
                                rtol=1e-6)
+
+
+def test_two_round_valid_sets_match_in_memory(workdir):
+    """two_round also streams VALIDATION files (binned against the train
+    mappers); recorded metrics must match the in-memory path."""
+    os.chdir(workdir)
+    common = ["task=train", "data=binary.train", "valid=binary.test",
+              "objective=binary", "metric=auc", "num_leaves=15",
+              "num_iterations=5", "verbosity=-1",
+              "bin_construct_sample_cnt=100000"]
+    cli_main(common + ["output_model=m_v_mem.txt"])
+    cli_main(common + ["two_round=true", "output_model=m_v_2r.txt"])
+    b1 = lgb.Booster(model_file=str(workdir / "m_v_mem.txt"))
+    b2 = lgb.Booster(model_file=str(workdir / "m_v_2r.txt"))
+    X = np.loadtxt(str(workdir / "binary.test"))[:, 1:]
+    np.testing.assert_allclose(b2.predict(X), b1.predict(X), rtol=1e-6)
+
+
+def test_qid_group_column_run_order(tmp_path):
+    """Query-id columns convert to group boundaries by consecutive runs in
+    FILE order, not sorted id order (metadata.cpp query column)."""
+    from lightgbm_tpu.cli import _qid_to_group
+    np.testing.assert_array_equal(_qid_to_group(np.array([7, 7, 7, 1, 1])),
+                                  [3, 2])
+    np.testing.assert_array_equal(_qid_to_group(np.array([2, 2, 9, 2])),
+                                  [2, 1, 1])
+    np.testing.assert_array_equal(_qid_to_group(np.array([])), [])

@@ -1,0 +1,144 @@
+"""Per-objective behavior matrix over the regression/xentropy families —
+the analog of the reference's giant parametrized objective coverage
+(reference: tests/python_package_test/test_engine.py: test_regression,
+test_quantile, test_huber, test_poisson/gamma/tweedie, test_mape,
+test_xentropy; semantics from src/objective/regression_objective.hpp and
+xentropy_objective.hpp)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _positive_problem(seed, n=1200):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-1, 1, size=(n, 4))
+    mu = np.exp(0.8 * X[:, 0] - 0.5 * X[:, 1])
+    return X, mu, rng
+
+
+def _train(X, y, objective, extra=None, rounds=40):
+    params = {"objective": objective, "num_leaves": 15,
+              "min_data_in_leaf": 20, "learning_rate": 0.1,
+              "verbosity": -1, **(extra or {})}
+    evals = {}
+    booster = lgb.train(params, lgb.Dataset(X, label=y), rounds,
+                        valid_sets=[lgb.Dataset(X, label=y)],
+                        valid_names=["t"], evals_result=evals)
+    return booster, evals["t"]
+
+
+@pytest.mark.parametrize("objective,metric", [
+    ("regression", "l2"), ("regression_l1", "l1"), ("huber", "huber"),
+    ("fair", "fair"), ("mape", "mape"),
+])
+def test_regression_family_metric_improves(objective, metric):
+    rng = np.random.RandomState(11)
+    n = 1200
+    X = rng.uniform(-2, 2, size=(n, 4))
+    y = 2 * X[:, 0] + np.sin(2 * X[:, 1]) + 0.2 * rng.normal(size=n)
+    if objective == "mape":
+        y = y + 6.0          # mape needs labels away from 0
+    _, ev = _train(X, y, objective)
+    hist = ev[metric]
+    assert hist[-1] < hist[0] * 0.8, (objective, hist[0], hist[-1])
+
+
+@pytest.mark.parametrize("objective", ["poisson", "gamma", "tweedie"])
+def test_positive_objectives_log_link(objective):
+    """Poisson/gamma/tweedie predict via exp(score): predictions must be
+    positive and the deviance metric must improve
+    (regression_objective.hpp:398,677,712)."""
+    X, mu, rng = _positive_problem(13)
+    if objective == "poisson":
+        y = rng.poisson(mu).astype(np.float64)
+    else:
+        y = mu * rng.gamma(2.0, 0.5, size=len(mu))
+    booster, ev = _train(X, y, objective)
+    pred = booster.predict(X)
+    assert np.all(pred > 0)
+    hist = ev[objective]
+    assert hist[-1] < hist[0], (hist[0], hist[-1])
+    # predictions track the conditional mean scale
+    assert 0.3 < np.mean(pred) / np.mean(y) < 3.0
+
+
+@pytest.mark.parametrize("alpha", [0.1, 0.5, 0.9])
+def test_quantile_coverage(alpha):
+    """Quantile regression's empirical coverage must approximate alpha
+    (regression_objective.hpp:478 + test_engine.py quantile tests)."""
+    rng = np.random.RandomState(17)
+    n = 4000
+    X = rng.uniform(-1, 1, size=(n, 3))
+    y = X[:, 0] + rng.normal(scale=0.5, size=n)
+    booster, _ = _train(X, y, "quantile", extra={"alpha": alpha}, rounds=60)
+    cover = float(np.mean(y <= booster.predict(X)))
+    assert abs(cover - alpha) < 0.08, (alpha, cover)
+
+
+def test_huber_less_outlier_sensitive_than_l2():
+    rng = np.random.RandomState(19)
+    n = 2000
+    X = rng.uniform(-1, 1, size=(n, 3))
+    y = X[:, 0].copy()
+    out_rows = rng.choice(n, 40, replace=False)
+    y[out_rows] += 60.0 * rng.choice([-1, 1], size=40)   # gross outliers
+    clean = np.setdiff1d(np.arange(n), out_rows)
+
+    def clean_mse(objective):
+        b, _ = _train(X, y, objective)
+        p = b.predict(X)
+        return float(np.mean((p[clean] - X[clean, 0]) ** 2))
+
+    assert clean_mse("huber") < clean_mse("regression") * 0.8
+
+
+def test_cross_entropy_objectives():
+    """xentropy/xentlambda accept soft labels in [0, 1]
+    (xentropy_objective.hpp:44,152)."""
+    rng = np.random.RandomState(23)
+    n = 1500
+    X = rng.uniform(-2, 2, size=(n, 4))
+    p_true = 1.0 / (1.0 + np.exp(-(X[:, 0] + 0.5 * X[:, 1])))
+    y = np.clip(p_true + 0.1 * rng.normal(size=n), 0, 1)   # soft labels
+    for objective, metric in (("cross_entropy", "cross_entropy"),
+                              ("cross_entropy_lambda",
+                               "cross_entropy_lambda")):
+        booster, ev = _train(X, y, objective)
+        pred = booster.predict(X)
+        if objective == "cross_entropy":
+            # sigmoid output (xentropy_objective.hpp:102-104)
+            assert np.all((pred >= 0) & (pred <= 1))
+        else:
+            # xentlambda converts via log1p(exp(.)) — positive, unbounded
+            # (xentropy_objective.hpp:233-235)
+            assert np.all(pred >= 0)
+        hist = ev[metric]
+        assert hist[-1] < hist[0], (objective, hist[0], hist[-1])
+        # predictions correlate with the underlying probability
+        assert np.corrcoef(pred, p_true)[0, 1] > 0.85
+
+
+def test_reg_sqrt_label_transform():
+    """reg_sqrt trains on sqrt(label) and squares predictions back
+    (regression_objective.hpp reg_sqrt handling)."""
+    rng = np.random.RandomState(29)
+    n = 1500
+    X = rng.uniform(0, 1, size=(n, 3))
+    y = (3 * X[:, 0] + 0.1 * rng.normal(size=n)) ** 2
+    b_sqrt, _ = _train(X, y, "regression", extra={"reg_sqrt": True})
+    pred = b_sqrt.predict(X)
+    r2 = 1 - np.mean((pred - y) ** 2) / np.var(y)
+    assert r2 > 0.8, r2
+
+
+def test_objective_alias_resolution():
+    """Objective aliases map like the reference's ParseObjectiveAlias."""
+    rng = np.random.RandomState(31)
+    X = rng.normal(size=(400, 3))
+    y = X[:, 0]
+    for alias in ("mse", "l2", "mean_squared_error"):
+        b = lgb.train({"objective": alias, "num_leaves": 7,
+                       "verbosity": -1}, lgb.Dataset(X, label=y), 3)
+        assert b._boosting.objective.name in ("regression", "l2"), alias
