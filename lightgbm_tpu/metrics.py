@@ -152,9 +152,10 @@ class GammaDevianceMetric(Metric):
 
     def eval(self, score, objective=None):
         score = self._convert(score, objective)
-        eps = 1e-10
-        frac = self.label / np.maximum(score, eps)
-        return 2.0 * self._wavg(-np.log(np.maximum(frac, eps)) + frac - 1.0)
+        # the reference reports HALF the conventional deviance: tmp -
+        # log(tmp) - 1 without the factor 2 (regression_metric.hpp:284-288)
+        frac = self.label / (score + 1e-9)
+        return self._wavg(-np.log(np.maximum(frac, 1e-300)) + frac - 1.0)
 
 
 class TweedieMetric(Metric):

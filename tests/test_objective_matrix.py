@@ -142,3 +142,41 @@ def test_objective_alias_resolution():
         b = lgb.train({"objective": alias, "num_leaves": 7,
                        "verbosity": -1}, lgb.Dataset(X, label=y), 3)
         assert b._boosting.objective.name in ("regression", "l2"), alias
+
+
+def test_metric_formulas_match_reference_pointwise():
+    """Pointwise numeric audit of the regression metric formulas against
+    the reference LossOnPoint definitions (regression_metric.hpp) — the
+    gamma sign and gamma_deviance scale bugs were caught this way."""
+    from lightgbm_tpu import metrics as M
+    from lightgbm_tpu.config import Config
+    rng = np.random.RandomState(0)
+    label = np.abs(rng.normal(size=300)) + 0.5
+    score = np.abs(rng.normal(size=300)) + 0.5
+    cfg = Config.from_params({"alpha": 0.9, "fair_c": 1.0,
+                              "tweedie_variance_power": 1.5})
+
+    d = score - label
+    x = np.abs(d)
+    theta = -1.0 / score
+    tmp = label / (score + 1e-9)
+    rho = 1.5
+    expect = {
+        "l2": np.mean(d ** 2),
+        "l1": np.mean(x),
+        "huber": np.mean(np.where(x <= 0.9, 0.5 * d * d,
+                                  0.9 * (x - 0.45))),
+        "fair": np.mean(x - np.log1p(x)),
+        "poisson": np.mean(score - label * np.log(score)),
+        "mape": np.mean(x / np.maximum(1.0, np.abs(label))),
+        "gamma": np.mean(-((label * theta + np.log(-theta)) / 1.0
+                           + (np.log(label) - np.log(label)))),
+        "gamma_deviance": np.mean(tmp - np.log(tmp) - 1.0),
+        "tweedie": np.mean(-label * score ** (1 - rho) / (1 - rho)
+                           + score ** (2 - rho) / (2 - rho)),
+    }
+    for name, ref in expect.items():
+        m = M.create_metric(name, cfg)
+        m.init(label, None)
+        got = float(m.eval(score, None))
+        np.testing.assert_allclose(got, ref, rtol=1e-9, err_msg=name)
