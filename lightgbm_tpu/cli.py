@@ -198,6 +198,17 @@ def _iter_parsed_chunks(path: str, config: Config,
                 yield emit(chunk)
 
 
+def _metadata_tail(path: str, ws: list, gs: list):
+    """Shared weight/group/init_score precedence for the streaming loaders:
+    in-file columns win, then side files, with qid runs converted to group
+    boundaries (metadata.cpp)."""
+    weight = np.concatenate(ws) if ws else _side_file(path, ".weight")
+    group = _side_file(path, ".query")
+    if group is None and gs:
+        group = _qid_to_group(np.concatenate(gs))
+    return weight, group, _side_file(path, ".init")
+
+
 def _two_round_eligible(path: str, config: Config) -> bool:
     """CSV/TSV with fixed columns only; linear trees need resident raw
     features. Ineligible files fall back to in-memory loading."""
@@ -268,12 +279,7 @@ def load_valid_two_round(path: str, config: Config, params: Dict[str, str],
     ds._feature_names = list(reference._feature_names)
     ds.raw_data_np = None
     ds._constructed = True
-    ds.weight = np.concatenate(ws) if ws else _side_file(path, ".weight")
-    group = _side_file(path, ".query")
-    if group is None and gs:
-        group = _qid_to_group(np.concatenate(gs))
-    ds.group = group
-    ds.init_score = _side_file(path, ".init")
+    ds.weight, ds.group, ds.init_score = _metadata_tail(path, ws, gs)
     log.info(f"two-round valid loading: {len(y)} rows")
     return ds
 
@@ -360,13 +366,7 @@ def load_dataset_two_round(path: str, config: Config,
     ds.raw_data_np = None
     ds._constructed = True
 
-    weight = np.concatenate(ws) if ws else _side_file(path, ".weight")
-    group = _side_file(path, ".query")
-    if group is None and gs:
-        group = _qid_to_group(np.concatenate(gs))
-    ds.weight = weight
-    ds.group = group
-    ds.init_score = _side_file(path, ".init")
+    ds.weight, ds.group, ds.init_score = _metadata_tail(path, ws, gs)
     log.info(f"two-round loading: {n_total} rows, "
              f"{len(ds.used_features)} used features")
     return ds

@@ -60,7 +60,7 @@ def _jax_already_initialized() -> bool:
 
 
 def _local_addresses() -> set:
-    addrs = {"127.0.0.1", "localhost", "0.0.0.0"}
+    addrs = {"127.0.0.1", "::1", "localhost", "0.0.0.0"}
     try:
         hostname = socket.gethostname()
         addrs.add(hostname)
@@ -68,7 +68,43 @@ def _local_addresses() -> set:
             addrs.add(info[4][0])
     except OSError:
         pass
+    # primary interface IP: a connected UDP socket reveals the address the
+    # kernel would route from (no packet is sent)
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        addrs.add(s.getsockname()[0])
+        s.close()
+    except OSError:
+        pass
     return addrs
+
+
+def _split_host_port(entry: str):
+    """host[:port] -> (host, port-str|None); handles [v6]:port and bare
+    IPv6 (which must not be split at its last hextet)."""
+    if entry.startswith("["):
+        host, _, rest = entry[1:].partition("]")
+        return host, (rest[1:] if rest.startswith(":") else None)
+    if entry.count(":") > 1:
+        return entry, None        # bare IPv6
+    host, _, port = entry.partition(":")
+    return host, (port or None)
+
+
+def _entry_matches_local(host: str, local: set) -> bool:
+    if host in local:
+        return True
+    # the reference compares RESOLVED addresses (linkers_socket.cpp:38):
+    # a machines entry may be an interface IP or FQDN that plain hostname
+    # probing never surfaces
+    try:
+        for info in socket.getaddrinfo(host, None):
+            if info[4][0] in local:
+                return True
+    except OSError:
+        pass
+    return False
 
 
 def _rank_from_machines(machines: list,
@@ -79,11 +115,12 @@ def _rank_from_machines(machines: list,
     exact host:port match; an ambiguous match without it is fatal rather
     than silently rank 0."""
     local = _local_addresses()
-    matches = [i for i, m in enumerate(machines)
-               if m.rsplit(":", 1)[0] in local]
+    parsed = [_split_host_port(m) for m in machines]
+    matches = [i for i, (host, _port) in enumerate(parsed)
+               if _entry_matches_local(host, local)]
     if listen_port is not None:
         exact = [i for i in matches
-                 if machines[i].rsplit(":", 1)[-1] == str(listen_port)]
+                 if parsed[i][1] == str(listen_port)]
         if len(exact) == 1:
             return exact[0]
     if len(matches) > 1:

@@ -1033,7 +1033,8 @@ class GBDT:
         # per-tree path.
         if it < end_iter:
             stacked = self._stacked()
-            max_chunk_iters = max(1, 64 * 1024 * 1024 // max(n * k, 1))
+            # cap the [t, n, k] float64 host buffer at ~256 MB
+            max_chunk_iters = max(1, (256 << 20) // 8 // max(n * k, 1))
             while it < end_iter:
                 ce = min(end_iter, it + max_chunk_iters)
                 if pred_early_stop:
