@@ -32,11 +32,30 @@ from ..objectives import ObjectiveFunction, create_objective
 from ..ops.split import SplitParams
 from ..utils import log
 from .grower import grow_tree
-from .tree import (HostTree, TreeArrays, predict_leaf_bins, predict_value_bins,
+from .tree import (HostTree, TreeArrays, predict_leaf_bins,
+                   predict_leaves_stacked, predict_value_bins,
                    predict_values_stacked, stack_trees)
 
 
 import functools
+
+
+def _chunk_iters_cap(n: int, k: int, itemsize: int) -> int:
+    """Iterations per stacked-predict dispatch so the [t, n, k] host buffer
+    stays under ~256 MB."""
+    return max(1, (256 << 20) // itemsize // max(n * k, 1))
+
+
+def _chunked_tree_ranges(start_it: int, end_it: int, k: int, n: int,
+                         itemsize: int):
+    """Yield (a, b) TREE ranges covering [start_it, end_it) iterations in
+    buffer-capped chunks (shared by the stacked value/leaf predict paths)."""
+    cap = _chunk_iters_cap(n, k, itemsize)
+    it = start_it
+    while it < end_it:
+        ce = min(end_it, it + cap)
+        yield it * k, ce * k
+        it = ce
 
 
 @functools.partial(jax.jit, static_argnames=("n",))
@@ -1033,8 +1052,7 @@ class GBDT:
         # per-tree path.
         if it < end_iter:
             stacked = self._stacked()
-            # cap the [t, n, k] float64 host buffer at ~256 MB
-            max_chunk_iters = max(1, (256 << 20) // 8 // max(n * k, 1))
+            max_chunk_iters = _chunk_iters_cap(n, k, itemsize=8)
             while it < end_iter:
                 ce = min(end_iter, it + max_chunk_iters)
                 if pred_early_stop:
@@ -1095,11 +1113,14 @@ class GBDT:
             if _is_scipy_sparse(X):
                 X = np.asarray(X.todense())
         cols = []
-        for it in range(start_iteration, end_iter):
+        it = start_iteration
+        while it < min(end_iter, self.loaded_iters):
             for c in range(k):
-                if it < self.loaded_iters:
-                    cols.append(self.loaded.trees[it * k + c].leaf_index(X))
-                elif bundled:
+                cols.append(self.loaded.trees[it * k + c].leaf_index(X))
+            it += 1
+        if bundled:
+            while it < end_iter:
+                for c in range(k):
                     idx = (it - self.loaded_iters) * k + c
                     mt = self._mt_cache.get(idx)
                     if mt is None:
@@ -1107,9 +1128,18 @@ class GBDT:
                                                  self.train_set.mappers)
                         self._mt_cache[idx] = mt
                     cols.append(mt.leaf_index(X))
-                else:
-                    tree = self.trees[(it - self.loaded_iters) * k + c]
-                    cols.append(np.asarray(predict_leaf_bins(tree, bins, mb)))
+                it += 1
+        elif it < end_iter:
+            # own trees: batched device dispatches over the stacked
+            # ensemble (like predict_raw — not one round trip per tree)
+            stacked = self._stacked()
+            n = bins.shape[0]
+            for a, b in _chunked_tree_ranges(
+                    it - self.loaded_iters, end_iter - self.loaded_iters,
+                    k, n, itemsize=4):
+                chunk = jax.tree.map(lambda x: x[a:b], stacked)
+                leaves = np.asarray(predict_leaves_stacked(chunk, bins, mb))
+                cols.extend(list(leaves))            # [t, n] -> t columns
         return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0),
                                                             np.int32)
 

@@ -204,6 +204,19 @@ def predict_value_ensemble(stacked: TreeArrays, bins: jax.Array,
 
 
 @jax.jit
+def predict_leaves_stacked(stacked: TreeArrays, bins: jax.Array,
+                           missing_bin: jax.Array) -> jax.Array:
+    """Per-tree leaf indices over a stacked ensemble in one device program
+    (the batched analog of the per-tree predict_leaf loop). Returns
+    [T, N] int32."""
+    def step(_, tree):
+        return _, predict_leaf_bins(tree, bins, missing_bin)
+
+    _, leaves = jax.lax.scan(step, 0, stacked)
+    return leaves
+
+
+@jax.jit
 def predict_values_stacked(stacked: TreeArrays, bins: jax.Array,
                            missing_bin: jax.Array) -> jax.Array:
     """Per-tree outputs over a stacked ensemble in ONE device program (the
