@@ -234,15 +234,20 @@ class AveragePrecisionMetric(Metric):
         y = (self.label > 0).astype(np.float64)
         w = self.weight if self.weight is not None else np.ones_like(y)
         order = np.argsort(-score, kind="stable")
-        ys, ws = y[order], w[order]
-        tp = np.cumsum(ys * ws)
-        fp = np.cumsum((1 - ys) * ws)
-        precision = tp / np.maximum(tp + fp, 1e-20)
-        total_pos = tp[-1]
-        if total_pos <= 0:
+        ys, ws, ss = y[order], w[order], np.asarray(score)[order]
+        # tied scores form ONE threshold group whose precision is taken
+        # AFTER including the whole group (binary_metric.hpp:270+ sweep)
+        boundary = np.concatenate([[True], ss[1:] != ss[:-1]])
+        grp = np.cumsum(boundary) - 1
+        pos_g = np.bincount(grp, weights=ys * ws)
+        tot_g = np.bincount(grp, weights=ws)
+        cum_pos = np.cumsum(pos_g)
+        cum_tot = np.cumsum(tot_g)
+        total_pos = cum_pos[-1]
+        if total_pos <= 0 or total_pos == np.sum(ws):
             return 1.0
-        recall_delta = np.diff(np.concatenate([[0.0], tp])) / total_pos
-        return float(np.sum(precision * recall_delta))
+        accum = float(np.sum(pos_g * (cum_pos / cum_tot)))
+        return accum / float(total_pos)
 
 
 # ------------------------------------------------------------ multiclass

@@ -289,3 +289,43 @@ def test_cv_runs_and_improves():
     assert curve[-1] > 0.85 and curve[-1] >= curve[0] - 1e-9
     sd_key = [k for k in res if k.endswith("auc-stdv")][0]
     assert len(res[sd_key]) == 15
+
+
+def test_average_precision_matches_reference_sweep():
+    """average_precision must follow the reference's threshold-group sweep
+    exactly (tied scores form one group whose precision is taken AFTER the
+    whole group, binary_metric.hpp:270+) — ours deviated on ties."""
+    from lightgbm_tpu import metrics as M
+    from lightgbm_tpu.config import Config
+
+    def ref_ap(y, score, w=None):
+        order = np.argsort(-score, kind="stable")
+        wv = np.ones(len(y)) if w is None else w
+        cur_pos = cur_neg = sum_pos = sum_pred = accum = 0.0
+        thr = score[order[0]]
+        for i in order:
+            if score[i] != thr:
+                thr = score[i]
+                sum_pos += cur_pos
+                sum_pred += cur_pos + cur_neg
+                accum += cur_pos * (sum_pos / sum_pred)
+                cur_pos = cur_neg = 0.0
+            if y[i] > 0:
+                cur_pos += wv[i]
+            else:
+                cur_neg += wv[i]
+        sum_pos += cur_pos
+        sum_pred += cur_pos + cur_neg
+        accum += cur_pos * (sum_pos / sum_pred)
+        sw = wv.sum()
+        return accum / sum_pos if (sum_pos > 0 and sum_pos != sw) else 1.0
+
+    rng = np.random.RandomState(0)
+    for use_w in (False, True):
+        y = (rng.uniform(size=400) > 0.5).astype(np.float64)
+        score = np.round(rng.normal(size=400), 1)       # heavy ties
+        w = rng.uniform(0.5, 2.0, size=400) if use_w else None
+        m = M.create_metric("average_precision", Config.from_params({}))
+        m.init(y, w)
+        np.testing.assert_allclose(m.eval(score, None), ref_ap(y, score, w),
+                                   rtol=1e-12)
