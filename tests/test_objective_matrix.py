@@ -329,3 +329,62 @@ def test_average_precision_matches_reference_sweep():
         m.init(y, w)
         np.testing.assert_allclose(m.eval(score, None), ref_ap(y, score, w),
                                    rtol=1e-12)
+
+
+def test_ranking_metrics_match_reference():
+    """NDCG@k (2^l - 1 gains, log2 discounts, ideal from sorted labels,
+    empty-gain queries = 1; dcg_calculator.cpp) and MAP@k
+    (map_metric.hpp:74-104 CalMapAtK denominator min(npos, k)) pinned to
+    literal reference transcriptions."""
+    from lightgbm_tpu import metrics as M
+    from lightgbm_tpu.config import Config
+    rng = np.random.RandomState(0)
+    groups = np.array([10, 7, 13, 10])
+    n = groups.sum()
+    y_rel = rng.randint(0, 4, size=n).astype(np.float64)
+    y_bin = (rng.uniform(size=n) > 0.6).astype(np.float64)
+    score = rng.normal(size=n)
+
+    def ref_ndcg(k):
+        out, s = [], 0
+        for g in groups:
+            yy, ss = y_rel[s:s+g], score[s:s+g]
+            s += g
+            kk = min(k, g)
+            order = np.argsort(-ss, kind="stable")
+            dcg = sum((2 ** yy[order[i]] - 1) / np.log2(2 + i)
+                      for i in range(kk))
+            ideal = np.sort(yy)[::-1]
+            idcg = sum((2 ** ideal[i] - 1) / np.log2(2 + i)
+                       for i in range(kk))
+            out.append(1.0 if idcg <= 0 else dcg / idcg)
+        return float(np.mean(out))
+
+    def ref_map(k):
+        out, s = [], 0
+        for g in groups:
+            yy, ss = y_bin[s:s+g], score[s:s+g]
+            s += g
+            order = np.argsort(-ss, kind="stable")
+            kk = min(k, g)
+            npos = int(np.sum(yy > 0.5))
+            hit, sap = 0, 0.0
+            for j in range(kk):
+                if yy[order[j]] > 0.5:
+                    hit += 1
+                    sap += hit / (j + 1.0)
+            out.append(sap / min(npos, kk) if npos > 0 else 1.0)
+        return float(np.mean(out))
+
+    for k in (1, 3, 5):
+        m = M.create_metric("ndcg", Config.from_params({"eval_at": [k]}))
+        m.init(y_rel, None, groups)
+        got = m.eval(score, None)
+        got = got[0] if isinstance(got, (list, tuple, np.ndarray)) else got
+        np.testing.assert_allclose(got, ref_ndcg(k), rtol=1e-9)
+        m2 = M.create_metric("map", Config.from_params({"eval_at": [k]}))
+        m2.init(y_bin, None, groups)
+        got2 = m2.eval(score, None)
+        got2 = got2[0] if isinstance(got2, (list, tuple, np.ndarray)) \
+            else got2
+        np.testing.assert_allclose(got2, ref_map(k), rtol=1e-9)
