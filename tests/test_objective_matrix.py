@@ -388,3 +388,72 @@ def test_ranking_metrics_match_reference():
         got2 = got2[0] if isinstance(got2, (list, tuple, np.ndarray)) \
             else got2
         np.testing.assert_allclose(got2, ref_map(k), rtol=1e-9)
+
+
+def test_lambdarank_lambdas_match_reference():
+    """Lambdarank pairwise lambdas/hessians pinned to a literal
+    transcription of the reference per-query loop (rank_objective.hpp:
+    140-226: truncation, deltaNDCG with score-distance regularization,
+    sigmoid-table-free exact sigmoid, log2 lambda normalization). Our
+    gradient convention is dL/dscore = minus the reference's lambda."""
+    import jax.numpy as jnp
+    from lightgbm_tpu import objectives as O
+    from lightgbm_tpu.config import Config
+    label_gain = [2 ** i - 1 for i in range(32)]
+
+    def ref(y, score, groups, sigmoid=2.0, trunc=30):
+        g_out = np.zeros_like(score)
+        h_out = np.zeros_like(score)
+        s = 0
+        for g in groups:
+            yy, ss = y[s:s+g], score[s:s+g]
+            order = np.argsort(-ss, kind="stable")
+            ideal = np.sort(yy)[::-1]
+            maxdcg = sum(label_gain[int(ideal[i])] / np.log2(2.0 + i)
+                         for i in range(min(trunc, g)))
+            inv = 1.0 / maxdcg if maxdcg > 0 else 0.0
+            lam, hes = np.zeros(g), np.zeros(g)
+            best, worst = ss[order[0]], ss[order[g - 1]]
+            sum_lam = 0.0
+            for i in range(min(g - 1, trunc)):
+                for j in range(i + 1, g):
+                    if yy[order[i]] == yy[order[j]]:
+                        continue
+                    hi_r, lo_r = ((i, j) if yy[order[i]] > yy[order[j]]
+                                  else (j, i))
+                    hi, lo = order[hi_r], order[lo_r]
+                    d = ss[hi] - ss[lo]
+                    gap = label_gain[int(yy[hi])] - label_gain[int(yy[lo])]
+                    pdisc = abs(1 / np.log2(2.0 + hi_r)
+                                - 1 / np.log2(2.0 + lo_r))
+                    dndcg = gap * pdisc * inv
+                    if best != worst:
+                        dndcg /= (0.01 + abs(d))
+                    p = 1.0 / (1.0 + np.exp(sigmoid * d))
+                    pl = -sigmoid * dndcg * p
+                    ph = sigmoid * sigmoid * dndcg * p * (1 - p)
+                    lam[lo] -= pl
+                    hes[lo] += ph
+                    lam[hi] += pl
+                    hes[hi] += ph
+                    sum_lam -= 2 * pl
+            if sum_lam > 0:
+                nf = np.log2(1 + sum_lam) / sum_lam
+                lam *= nf
+                hes *= nf
+            g_out[s:s+g], h_out[s:s+g] = lam, hes
+            s += g
+        return -g_out, h_out
+
+    rng = np.random.RandomState(0)
+    groups = np.array([12, 8, 15])
+    y = rng.randint(0, 4, size=groups.sum()).astype(np.float64)
+    score = rng.normal(size=groups.sum())
+    obj = O.create_objective(Config.from_params(
+        {"objective": "lambdarank", "sigmoid": 2.0,
+         "lambdarank_truncation_level": 30}))
+    obj.init(y, None, groups)
+    g, h = obj.get_grad_hess(jnp.asarray(score))
+    g_ref, h_ref = ref(y, score, groups)
+    np.testing.assert_allclose(np.asarray(g), -g_ref, rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), h_ref, rtol=2e-3, atol=1e-5)
