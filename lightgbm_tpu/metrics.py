@@ -285,21 +285,32 @@ class AucMuMetric(Metric):
     bigger_is_better = True
 
     def eval(self, score, objective=None):
-        prob = self._convert(score, objective)
+        # the reference ranks by RAW score distances from the separating
+        # hyperplane (multiclass_metric.hpp:238-266) — no softmax; with
+        # auc_mu_weights the decision value is (W_i - W_j) . score
+        s_raw = np.asarray(score)
         yi = self.label.astype(np.int64)
-        k = prob.shape[1]
+        k = s_raw.shape[1]
         w = self.weight if self.weight is not None else np.ones(len(yi))
+        amw = list(self.config.auc_mu_weights or [])
+        if amw:
+            if len(amw) != k * k:
+                log.fatal(f"auc_mu_weights must have {k * k} elements")
+            W = np.asarray(amw, np.float64).reshape(k, k)
+        else:
+            W = 1.0 - np.eye(k)
         aucs = []
         for a in range(k):
             for b in range(a + 1, k):
                 mask = (yi == a) | (yi == b)
                 if not mask.any():
                     continue
-                # decision value: difference of the two class scores
-                s = prob[mask, a] - prob[mask, b]
+                curr_v = W[a] - W[b]
+                t1 = curr_v[a] - curr_v[b]
+                d = t1 * (s_raw[mask] @ curr_v)
                 sub = AUCMetric(self.config)
                 sub.init((yi[mask] == a).astype(np.float64), w[mask])
-                aucs.append(sub.eval(s, None))
+                aucs.append(sub.eval(d, None))
         return float(np.mean(aucs)) if aucs else 1.0
 
 

@@ -395,7 +395,8 @@ def test_lambdarank_lambdas_match_reference():
     transcription of the reference per-query loop (rank_objective.hpp:
     140-226: truncation, deltaNDCG with score-distance regularization,
     sigmoid-table-free exact sigmoid, log2 lambda normalization). Our
-    gradient convention is dL/dscore = minus the reference's lambda."""
+    get_grad_hess returns the reference's lambdas verbatim (the boosting
+    loop consumes them with the same sign convention)."""
     import jax.numpy as jnp
     from lightgbm_tpu import objectives as O
     from lightgbm_tpu.config import Config
@@ -443,7 +444,7 @@ def test_lambdarank_lambdas_match_reference():
                 hes *= nf
             g_out[s:s+g], h_out[s:s+g] = lam, hes
             s += g
-        return -g_out, h_out
+        return g_out, h_out
 
     rng = np.random.RandomState(0)
     groups = np.array([12, 8, 15])
@@ -455,5 +456,38 @@ def test_lambdarank_lambdas_match_reference():
     obj.init(y, None, groups)
     g, h = obj.get_grad_hess(jnp.asarray(score))
     g_ref, h_ref = ref(y, score, groups)
-    np.testing.assert_allclose(np.asarray(g), -g_ref, rtol=2e-3, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g), g_ref, rtol=2e-3, atol=1e-5)
     np.testing.assert_allclose(np.asarray(h), h_ref, rtol=2e-3, atol=1e-5)
+
+
+def test_auc_mu_raw_scores_and_weight_matrix():
+    """auc_mu ranks by raw-score hyperplane distances (no softmax) and
+    honors auc_mu_weights (multiclass_metric.hpp:238-266: decision value
+    (W_i - W_j) . score scaled by t1)."""
+    from lightgbm_tpu import metrics as M
+    from lightgbm_tpu.config import Config
+    rng = np.random.RandomState(0)
+    K, n = 3, 300
+    y = rng.randint(0, K, size=n).astype(np.float64)
+    S = rng.normal(size=(n, K))
+    m = M.create_metric("auc_mu", Config.from_params({"num_class": K}))
+    m.init(y, None)
+    base = m.eval(S, None)
+    # raw-score ranking is invariant to per-row shifts (softmax probs are
+    # not order-equivalent across rows; the old implementation failed this)
+    shifted = m.eval(S + rng.normal(size=(n, 1)), None)
+    np.testing.assert_allclose(base, shifted, rtol=1e-12)
+    # uniform default equals mean pairwise AUC of score differences
+    from sklearn.metrics import roc_auc_score
+    aucs = []
+    for a in range(K):
+        for b in range(a + 1, K):
+            mask = (y == a) | (y == b)
+            aucs.append(roc_auc_score((y[mask] == a).astype(float),
+                                      S[mask, a] - S[mask, b]))
+    np.testing.assert_allclose(base, np.mean(aucs), rtol=1e-9)
+    # a custom weight matrix changes the decision values
+    mw = M.create_metric("auc_mu", Config.from_params(
+        {"num_class": K, "auc_mu_weights": [0, 1, 5, 1, 0, 1, 5, 1, 0]}))
+    mw.init(y, None)
+    assert abs(mw.eval(S, None) - base) > 1e-4
