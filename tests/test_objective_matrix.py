@@ -491,3 +491,53 @@ def test_auc_mu_raw_scores_and_weight_matrix():
         {"num_class": K, "auc_mu_weights": [0, 1, 5, 1, 0, 1, 5, 1, 0]}))
     mw.init(y, None)
     assert abs(mw.eval(S, None) - base) > 1e-4
+
+
+def test_treeshap_matches_bruteforce_shapley():
+    """pred_contrib equals brute-force path-dependent Shapley values
+    (exact subset enumeration with cover-weighted conditional expectations
+    — the semantics of the reference's TreeSHAP, tree.cpp PredictContrib)."""
+    import math
+    from itertools import combinations
+    rng = np.random.RandomState(0)
+    n, F = 600, 4
+    X = rng.normal(size=(n, F))
+    y = X[:, 0] + 0.7 * X[:, 1] * X[:, 2] + 0.1 * rng.normal(size=n)
+    b = lgb.train({"objective": "regression", "num_leaves": 8,
+                   "min_data_in_leaf": 20, "verbosity": -1},
+                  lgb.Dataset(X, label=y), 1)
+    contrib = b.predict(X[:5], pred_contrib=True)
+    tree = b._boosting.host_trees[0]
+    sf = np.asarray(tree.split_feature)
+    thr = np.asarray(tree.threshold)
+    lc = np.asarray(tree.left_child)
+    rc = np.asarray(tree.right_child)
+    lv = np.asarray(tree.leaf_value)
+    lcount = np.asarray(tree.leaf_count, float)
+    icount = np.asarray(tree.internal_count, float)
+
+    def cover(node):
+        return icount[node] if node >= 0 else lcount[~node]
+
+    def exp_f(x, S, node=0):
+        if node < 0:
+            return lv[~node]
+        f = sf[node]
+        if f in S:
+            return exp_f(x, S, lc[node] if x[f] <= thr[node] else rc[node])
+        wl, wr = cover(lc[node]), cover(rc[node])
+        return (wl * exp_f(x, S, lc[node])
+                + wr * exp_f(x, S, rc[node])) / (wl + wr)
+
+    for r in range(5):
+        phis = np.zeros(F + 1)
+        for i in range(F):
+            others = [f for f in range(F) if f != i]
+            for k in range(F):
+                for S in combinations(others, k):
+                    w = (math.factorial(k) * math.factorial(F - k - 1)
+                         / math.factorial(F))
+                    phis[i] += w * (exp_f(X[r], set(S) | {i})
+                                    - exp_f(X[r], set(S)))
+        phis[F] = exp_f(X[r], set())
+        np.testing.assert_allclose(contrib[r], phis, rtol=1e-5, atol=1e-7)
