@@ -152,10 +152,15 @@ class GammaDevianceMetric(Metric):
 
     def eval(self, score, objective=None):
         score = self._convert(score, objective)
-        # the reference reports HALF the conventional deviance: tmp -
-        # log(tmp) - 1 without the factor 2 (regression_metric.hpp:284-288)
+        # reference: LossOnPoint = tmp - log(tmp) - 1 per row, but the
+        # AverageLoss override (regression_metric.hpp:291-293) returns
+        # sum_loss * 2 and IGNORES sum_weights — i.e. 2x the weighted SUM,
+        # not a mean.
         frac = self.label / (score + 1e-9)
-        return self._wavg(-np.log(np.maximum(frac, 1e-300)) + frac - 1.0)
+        loss = -np.log(np.maximum(frac, 1e-300)) + frac - 1.0
+        if self.weight is not None:
+            loss = loss * self.weight
+        return 2.0 * float(np.sum(loss))
 
 
 class TweedieMetric(Metric):

@@ -171,7 +171,9 @@ def test_metric_formulas_match_reference_pointwise():
         "mape": np.mean(x / np.maximum(1.0, np.abs(label))),
         "gamma": np.mean(-((label * theta + np.log(-theta)) / 1.0
                            + (np.log(label) - np.log(label)))),
-        "gamma_deviance": np.mean(tmp - np.log(tmp) - 1.0),
+        # AverageLoss override: sum_loss * 2, sum_weights IGNORED
+        # (regression_metric.hpp:291-293) — 2x the SUM, not a mean
+        "gamma_deviance": 2.0 * np.sum(tmp - np.log(tmp) - 1.0),
         "tweedie": np.mean(-label * score ** (1 - rho) / (1 - rho)
                            + score ** (2 - rho) / (2 - rho)),
     }
@@ -180,6 +182,15 @@ def test_metric_formulas_match_reference_pointwise():
         m.init(label, None)
         got = float(m.eval(score, None))
         np.testing.assert_allclose(got, ref, rtol=1e-9, err_msg=name)
+
+    # weighted gamma_deviance: loss is weighted per row, but the final
+    # AverageLoss divides by nothing — 2 * sum(w * loss)
+    w = np.abs(rng.normal(size=300)) + 0.1
+    m = M.create_metric("gamma_deviance", cfg)
+    m.init(label, w)
+    got = float(m.eval(score, None))
+    np.testing.assert_allclose(
+        got, 2.0 * np.sum(w * (tmp - np.log(tmp) - 1.0)), rtol=1e-9)
 
 
 def test_gradient_formulas_match_reference_pointwise():
