@@ -589,7 +589,8 @@ class GBDT:
 
     def _hist_method(self) -> str:
         from ..ops.histogram import resolve_method
-        return resolve_method(self.config.histogram_method)
+        return resolve_method(self.config.histogram_method,
+                              deterministic=self.config.deterministic)
 
     def _sample_weights(self, g, h) -> Optional[jax.Array]:
         """Hook for GOSS-style reweighted sampling; None = use bag mask."""

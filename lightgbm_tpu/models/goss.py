@@ -34,6 +34,13 @@ from .gbdt import GBDT
 _MAXU = jnp.uint32(0xFFFFFFFF)
 
 
+def _stable_ranks(x: jax.Array) -> jax.Array:
+    """rank[i] = position of element i in the ascending stable sort of x
+    (equal values keep row order — argsort of an argsort inverts the
+    stable sort permutation)."""
+    return jnp.argsort(jnp.argsort(x))
+
+
 @functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
 def goss_weights(score: jax.Array, key: jax.Array, top_k: int,
                  other_k: int) -> jax.Array:
@@ -42,10 +49,13 @@ def goss_weights(score: jax.Array, key: jax.Array, top_k: int,
     single-core sort + weight upload serialized every iteration).
 
     Exact counts: exactly ``top_k`` rows keep weight 1 (threshold = k-th
-    largest score; ties broken by random uint32 draws) and exactly
-    ``other_k`` of the rest keep the amplification weight
-    (n - top_k)/other_k (selected as the other_k smallest random draws —
-    the device analog of sampling without replacement).
+    largest score; score ties broken by random 31-bit draws, draw
+    collisions broken by row index via a stable rank — thresholding the
+    draws directly would admit every colliding row, overshooting the
+    targets by the collision count at 10M-row scale) and exactly
+    ``min(other_k, n - top_k)`` of the rest keep the amplification weight
+    (n - top_k)/other_k — the device analog of sampling without
+    replacement.
     """
     n = score.shape[0]
     svals = jnp.sort(score)
@@ -53,18 +63,17 @@ def goss_weights(score: jax.Array, key: jax.Array, top_k: int,
     strict = score > t
     c1 = jnp.sum(strict.astype(jnp.int32))
     tie = score == t
-    r = jax.random.bits(key, (n,), jnp.uint32)
-    # pick the (top_k - c1) ties with the smallest tie-break draws
+    # draws are shifted to 31 bits so real candidates always sort ahead of
+    # the _MAXU filler on excluded rows
+    r = jax.random.bits(key, (n,), jnp.uint32) >> 1
+    # pick the (top_k - c1) ties with the smallest tie-break ranks
     rt = jnp.where(tie, r, _MAXU)
-    need = top_k - c1
-    thr_tie = jnp.sort(rt)[jnp.maximum(need - 1, 0)]
-    is_top = strict | (tie & (rt <= thr_tie) & (need > 0))
+    is_top = strict | (tie & (_stable_ranks(rt) < top_k - c1))
     rest = ~is_top
-    r2 = jax.random.bits(jax.random.fold_in(key, 1), (n,), jnp.uint32)
+    r2 = jax.random.bits(jax.random.fold_in(key, 1), (n,), jnp.uint32) >> 1
     rr = jnp.where(rest, r2, _MAXU)
     kk = min(other_k, n - top_k)               # rest count is n - top_k
-    thr_other = jnp.sort(rr)[jnp.maximum(kk - 1, 0)]
-    pick = rest & (rr <= thr_other)
+    pick = rest & (_stable_ranks(rr) < kk)
     multiply = jnp.float32((n - top_k) / other_k)   # goss.hpp:119-121
     return (is_top.astype(jnp.float32)
             + pick.astype(jnp.float32) * multiply)

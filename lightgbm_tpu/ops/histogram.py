@@ -115,18 +115,35 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def resolve_method(method: str) -> str:
+# (method, reasons) combinations already warned about — one warning per
+# distinct degradation, not one per trace
+_pallas_fallback_warned: set = set()
+
+
+def resolve_method(method: str, deterministic: bool = False) -> str:
     """Map ``histogram_method="auto"`` to the platform's fast backend
     (the analog of the reference's col-wise/row-wise auto benchmark,
     dataset.cpp:591-689 TestMultiThreadingMethod — here the choice is
     platform-structural: scatter-add is fast on CPU hosts and pathologically
     serialized on TPU, where the fused Pallas kernel wins; measured on v5e
     at Higgs shape the ladder is pallas_hilo < pallas ~ onehot << scatter).
+
+    ``pallas_hilo`` rounds grad/hess inputs to a hi+lo bf16 pair (~2^-17
+    relative, vs f32's 2^-24) before the MXU contraction; near-tied split
+    gains can therefore differ from a full-f32 run. ``deterministic=True``
+    (the reference's reproducibility flag, config.h:166) keeps ``auto`` on
+    the HIGHEST-precision kernel so results are stable across
+    histogram-method choices at ~1.7x the pass cost.
+
     ``histogram_tiles`` falls back from a pallas method to the equivalent
     XLA onehot contraction when the kernel's preconditions don't hold
-    (non-TPU backend, no feature-major bins, f64 accumulation)."""
+    (non-TPU backend, no feature-major bins, f64 accumulation, or
+    tile_leaves*stats exceeding the 128-lane group) and warns once per
+    precondition."""
     if method == "auto":
-        return "pallas_hilo" if jax.default_backend() == "tpu" else "scatter"
+        if jax.default_backend() != "tpu":
+            return "scatter"
+        return "pallas" if deterministic else "pallas_hilo"
     return method
 
 
@@ -161,16 +178,36 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
         # the fused kernel needs: real TPU lowering, the feature-major bin
         # matrix, f32 accumulation, and the tile x stat channels within one
         # 128-lane group; otherwise run the XLA onehot formulation of the
-        # same contraction
-        from . import pallas_hist
-        if (jax.default_backend() == "tpu" and binsT is not None
-                and (dtype == jnp.float32 or method == "pallas_q8")
-                and p * s <= 128):
+        # same contraction. ``reasons`` IS the gate: empty means every
+        # precondition holds, so the warning can never disagree with it.
+        reasons = []
+        if jax.default_backend() != "tpu":
+            reasons.append(f"backend is {jax.default_backend()!r}, not tpu")
+        if binsT is None:
+            reasons.append("feature-major bin matrix (binsT) unavailable")
+        if not (dtype == jnp.float32 or method == "pallas_q8"):
+            reasons.append(f"accumulation dtype {jnp.dtype(dtype).name} "
+                           "(kernel is f32-only)")
+        if p * s > 128:
+            reasons.append(f"tile_leaves*stats = {p}*{s} = {p * s} > 128 "
+                           "lanes (lower tile_leaves)")
+        if not reasons:
+            from . import pallas_hist
             kmode = {"pallas": "highest", "pallas_hilo": "hilo",
                      "pallas_q8": "q8"}[method]
             return pallas_hist.histogram_tiles_pallas_mode(
                 binsT, stats, leaf_ids, sel, num_bins,
                 block=block or 2048, mode=kmode)
+        # an explicitly requested kernel silently degrading to the XLA
+        # formulation is a large perf cliff — name the violated
+        # precondition once so the user can tell why
+        key = (method, tuple(reasons))
+        if key not in _pallas_fallback_warned:
+            _pallas_fallback_warned.add(key)
+            from ..utils import log
+            log.warning(
+                f"histogram_method={method!r} fell back to the XLA onehot "
+                f"formulation: {'; '.join(reasons)}")
         method = {"pallas": "onehot", "pallas_hilo": "onehot_hilo",
                   "pallas_q8": "onehot_q8"}[method]
 

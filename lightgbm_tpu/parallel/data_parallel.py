@@ -51,6 +51,7 @@ def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
                  feature_mask: jax.Array, missing_bin: jax.Array, *,
                  max_leaves: int, num_bins: int, max_depth: int = -1,
                  hist_method: str = "auto",
+                 deterministic: bool = False,
                  exact: bool = False,
                  with_categorical: bool = False,
                  axis: str = "data") -> Tuple[TreeArrays, jax.Array]:
@@ -74,6 +75,7 @@ def grow_tree_dp(mesh: Mesh, bins: jax.Array, grad: jax.Array, hess: jax.Array,
     tree, leaf_id, _aux = pg(
         bins, grad, hess, sample_mask, meta, params, feature_mask,
         missing_bin, max_leaves=max_leaves, num_bins=num_bins,
-        max_depth=max_depth, hist_method=resolve_method(hist_method),
+        max_depth=max_depth,
+        hist_method=resolve_method(hist_method, deterministic=deterministic),
         exact=exact, with_categorical=with_categorical)
     return tree, leaf_id

@@ -93,6 +93,36 @@ def test_goss_amplifies_small_gradients():
                       rtol=1e-5) or np.max(w_np) == 1.0
 
 
+def test_goss_weights_exact_counts_under_ties():
+    """goss_weights selects EXACTLY top_k + min(other_k, n-top_k) rows even
+    when the |g*h| score is massively tied (draw-threshold selection would
+    overshoot by the number of colliding draws)."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.models.goss import goss_weights
+
+    n, top_k, other_k = 10_000, 1_000, 2_000
+    # all-constant scores: every row is a threshold tie
+    score = jnp.ones((n,), jnp.float32)
+    w = np.asarray(goss_weights(score, jax.random.PRNGKey(0), top_k, other_k))
+    assert np.count_nonzero(w == 1.0) == top_k
+    mult = (n - top_k) / other_k
+    assert np.count_nonzero(np.isclose(w, mult)) == other_k
+    assert np.count_nonzero(w) == top_k + other_k
+
+    # mixed: strict top block + tied middle + distinct tail
+    rng = np.random.RandomState(3)
+    score2 = jnp.asarray(np.concatenate([
+        np.full(500, 9.0), np.full(5000, 5.0),
+        rng.uniform(0, 1, n - 5500)]).astype(np.float32))
+    w2 = np.asarray(goss_weights(score2, jax.random.PRNGKey(7),
+                                 top_k, other_k))
+    assert np.count_nonzero(w2 == 1.0) == top_k
+    assert np.count_nonzero(w2) == top_k + other_k
+    # the 500 strictly-largest scores are always kept at weight 1
+    assert np.all(w2[:500] == 1.0)
+
+
 def test_dart_vs_gbdt_with_skip_drop_one():
     """skip_drop=1.0 means never drop: DART must match plain GBDT exactly."""
     X, y = _binary_problem(n=300)
