@@ -254,7 +254,9 @@ class RankXENDCG(RankingObjective):
         rho = jax.nn.softmax(s, axis=1)
         rho = jnp.where(mask, rho, 0.0)
 
-        phi = jnp.where(mask, jnp.exp2(label) - gamma, 0.0)
+        # Phi(l, g) = 2^int(l) - g (rank_objective.hpp:356-358); labels are
+        # truncated toward zero like the reference's static_cast<int>
+        phi = jnp.where(mask, jnp.exp2(jnp.trunc(label)) - gamma, 0.0)
         inv_den = 1.0 / jnp.maximum(jnp.sum(phi, axis=1, keepdims=True), K_EPSILON)
 
         # first-order terms

@@ -552,3 +552,153 @@ def test_treeshap_matches_bruteforce_shapley():
                                     - exp_f(X[r], set(S)))
         phis[F] = exp_f(X[r], set())
         np.testing.assert_allclose(contrib[r], phis, rtol=1e-5, atol=1e-7)
+
+
+def test_rank_xendcg_matches_reference_pointwise():
+    """Literal transcription of RankXENDCG::GetGradientsForOneQuery
+    (rank_objective.hpp:301-358: softmax rho, Phi(l,g)=2^int(l)-g, the
+    three cascaded correction sweeps) vs our vectorized padded program,
+    sharing the same per-doc gamma draws."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.ranking import RankXENDCG
+
+    rng = np.random.RandomState(5)
+    groups = np.array([1, 7, 12, 3, 2, 9])
+    n = int(groups.sum())
+    label = rng.randint(0, 4, size=n).astype(np.float64)
+    score = np.round(rng.normal(size=n), 1)          # tie-heavy scores
+
+    obj = RankXENDCG(Config.from_params({"objective": "rank_xendcg",
+                                         "seed": 7}))
+    obj.init(label, None, groups)
+    gamma_pad = rng.uniform(size=obj.q_mask.shape).astype(np.float32)
+    lam_pad, hess_pad = obj._padded_grads(
+        jnp.asarray(score, jnp.float32)[obj.doc_index],
+        jnp.asarray(gamma_pad))
+    lam, hess = obj._scatter_grads(lam_pad, hess_pad)
+    lam, hess = np.asarray(lam), np.asarray(hess)
+
+    def ref_one_query(cnt, lab, sc, gam):
+        lambdas = np.zeros(cnt)
+        hessians = np.zeros(cnt)
+        if cnt <= 1:                       # rank_objective.hpp:305-311
+            return lambdas, hessians
+        rho = np.exp(sc - sc.max())        # Common::Softmax (common.h:567)
+        rho = rho / rho.sum()
+        params = np.empty(cnt)
+        inv_denominator = 0.0
+        for i in range(cnt):
+            params[i] = 2.0 ** int(lab[i]) - gam[i]   # Phi, :356-358
+            inv_denominator += params[i]
+        inv_denominator = 1.0 / max(1e-15, inv_denominator)  # kEpsilon
+        sum_l1 = 0.0
+        for i in range(cnt):
+            term = -params[i] * inv_denominator + rho[i]
+            lambdas[i] = np.float32(term)
+            params[i] = term / (1.0 - rho[i])
+            sum_l1 += params[i]
+        sum_l2 = 0.0
+        for i in range(cnt):
+            term = rho[i] * (sum_l1 - params[i])
+            lambdas[i] += np.float32(term)
+            params[i] = term / (1.0 - rho[i])
+            sum_l2 += params[i]
+        for i in range(cnt):
+            lambdas[i] += np.float32(rho[i] * (sum_l2 - params[i]))
+            hessians[i] = np.float32(rho[i] * (1.0 - rho[i]))
+        return lambdas, hessians
+
+    bounds = np.concatenate([[0], np.cumsum(groups)])
+    for q in range(len(groups)):
+        b0, b1 = bounds[q], bounds[q + 1]
+        cnt = b1 - b0
+        ref_lam, ref_hess = ref_one_query(
+            cnt, label[b0:b1], score[b0:b1], gamma_pad[q, :cnt])
+        np.testing.assert_allclose(lam[b0:b1], ref_lam,
+                                   rtol=2e-4, atol=2e-6,
+                                   err_msg=f"query {q} lambdas")
+        np.testing.assert_allclose(hess[b0:b1], ref_hess,
+                                   rtol=2e-4, atol=2e-6,
+                                   err_msg=f"query {q} hessians")
+
+
+def test_percentile_functions_match_reference():
+    """Literal transcriptions of PercentileFun / WeightedPercentileFun
+    (regression_objective.hpp:18-88) pinned against our implementations on
+    tie-heavy data, including the label_t (f32) result rounding of the
+    BoostFromScore instantiation (regression_objective.hpp:241-246)."""
+    from lightgbm_tpu.objectives import _percentile, _weighted_percentile
+
+    def ref_percentile(data, alpha, T=np.float64):
+        # PercentileFun: ArgMaxAtK partitions descending (array_args.h:128
+        # "k=0 means get the max"); a full descending sort is the same
+        # selection, and both branches of `pos > cnt/2` pick
+        # v1=desc[pos-1], v2=desc[pos]
+        data = np.asarray(data, T)
+        cnt = len(data)
+        if cnt <= 1:
+            return T(data[0])
+        desc = np.sort(data)[::-1]
+        float_pos = (1.0 - alpha) * cnt
+        pos = int(float_pos)
+        if pos < 1:
+            return desc[0]                       # ArgMax
+        if pos >= cnt:
+            return desc[-1]                      # ArgMin
+        bias = float_pos - pos
+        v1, v2 = desc[pos - 1], desc[pos]
+        return T(v1 - (v1 - v2) * bias)
+
+    def ref_weighted_percentile(data, weight, alpha, T=np.float64):
+        data = np.asarray(data, T)
+        cnt = len(data)
+        if cnt <= 1:
+            return T(data[0])
+        sorted_idx = np.argsort(data, kind="stable")   # std::stable_sort
+        weighted_cdf = np.cumsum(np.asarray(weight, np.float64)[sorted_idx])
+        threshold = weighted_cdf[cnt - 1] * alpha
+        pos = int(np.searchsorted(weighted_cdf, threshold, side="right"))
+        pos = min(pos, cnt - 1)
+        if pos == 0 or pos == cnt - 1:
+            return T(data[sorted_idx[pos]])
+        assert threshold >= weighted_cdf[pos - 1]      # CHECK_GE
+        assert threshold < weighted_cdf[pos]           # CHECK_LT
+        v1 = data[sorted_idx[pos - 1]]
+        v2 = data[sorted_idx[pos]]
+        if weighted_cdf[pos + 1] - weighted_cdf[pos] >= 1.0:
+            return T((threshold - weighted_cdf[pos])
+                     / (weighted_cdf[pos + 1] - weighted_cdf[pos])
+                     * (v2 - v1) + v1)
+        return T(v2)
+
+    rng = np.random.RandomState(11)
+    alphas = [0.05, 0.1, 0.5, 0.9, 0.95]
+    for trial in range(40):
+        n = int(rng.choice([1, 2, 3, 5, 10, 101, 500]))
+        # heavy ties: values drawn from a tiny grid
+        data = np.round(rng.normal(size=n) * 2.0, 1)
+        # weights spanning tiny-to-large so the cdf-gap >= 1.0 branch and
+        # the v2 branch are both exercised
+        weight = np.exp(rng.uniform(-3, 2, size=n))
+        for alpha in alphas:
+            ours = _percentile(data, alpha)
+            ref = ref_percentile(data, alpha)
+            np.testing.assert_allclose(ours, ref, rtol=0, atol=0,
+                                       err_msg=f"n={n} alpha={alpha}")
+            ours_w = _weighted_percentile(data, weight, alpha)
+            ref_w = ref_weighted_percentile(data, weight, alpha)
+            np.testing.assert_allclose(ours_w, ref_w, rtol=0, atol=0,
+                                       err_msg=f"weighted n={n} alpha={alpha}")
+            # the BoostFromScore instantiation stores label_t (f32) data
+            # and casts the result back to label_t; its C++ `v1 - v2` also
+            # rounds to f32 BEFORE the double interpolation (float-float
+            # arithmetic stays float), while our pipeline interpolates
+            # fully in f64 — the rounding error scales with the data
+            # SPREAD (ulp of v1-v2), not the result, so bound absolutely
+            f32 = np.float32
+            np.testing.assert_allclose(
+                f32(_percentile(data.astype(f32), alpha)),
+                ref_percentile(data, alpha, T=f32), rtol=0,
+                atol=1.2e-7 * max(1.0, float(np.ptp(data))),
+                err_msg=f"f32 n={n} alpha={alpha}")
