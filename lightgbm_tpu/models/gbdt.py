@@ -659,6 +659,7 @@ class GBDT:
             new_ht.leaf_features_raw = old_ht.leaf_features_raw
         self.host_trees[-1] = new_ht
         self._mt_cache.pop(len(self.host_trees) - 1, None)
+        self._contrib_tree_cache = None      # in-place replacement
         self.tree_bias.append(bias)
         self._stacked_cache = None
 
@@ -873,6 +874,9 @@ class GBDT:
         if self.iter <= 0:
             return
         k = self.num_tree_per_iteration
+        # tree count returns to a previously-seen value after retraining,
+        # so the count-keyed contrib cache would serve the popped trees
+        self._contrib_tree_cache = None
         for c in range(k):
             tree = self.trees.pop()
             self.host_trees.pop()
@@ -1158,14 +1162,24 @@ class GBDT:
         else:
             end_iter = min(start_iteration + num_iteration, total_iters)
         mappers = self.train_set.mappers
-        trees = []
-        for it in range(start_iteration, end_iter):
-            for c in range(k):
-                if it < self.loaded_iters:
-                    trees.append(self.loaded.trees[it * k + c])
-                else:
-                    trees.append(ModelTree.from_host(
-                        self.host_trees[(it - self.loaded_iters) * k + c], mappers))
+        # reuse the converted ModelTree list across calls (stable object
+        # identities also let the SHAP stack cache skip its precompute)
+        cache_key = (start_iteration, end_iter, len(self.trees),
+                     self.loaded_iters)
+        cached = getattr(self, "_contrib_tree_cache", None)
+        if cached is not None and cached[0] == cache_key:
+            trees = cached[1]
+        else:
+            trees = []
+            for it in range(start_iteration, end_iter):
+                for c in range(k):
+                    if it < self.loaded_iters:
+                        trees.append(self.loaded.trees[it * k + c])
+                    else:
+                        trees.append(ModelTree.from_host(
+                            self.host_trees[(it - self.loaded_iters) * k + c],
+                            mappers))
+            self._contrib_tree_cache = (cache_key, trees)
         return predict_contrib_trees(trees, X,
                                      self.train_set.num_total_features, k,
                                      average=self.average_output)

@@ -1,0 +1,165 @@
+"""Batched TreeSHAP (io/shap.py fast path) vs the per-row oracle.
+
+The oracle (predict_contrib_trees_reference) is itself pinned against
+brute-force Shapley values in test_objective_matrix.py; these tests pin the
+vectorized leaf-path/GEMM formulation against the oracle across the tricky
+decision semantics (categoricals, NaN routing, multiclass, deep trees)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io import shap as S
+from lightgbm_tpu.io.model_text import ModelTree
+
+
+def _model_trees(booster):
+    gb = booster._boosting
+    return [ModelTree.from_host(ht, gb.train_set.mappers)
+            for ht in gb.host_trees]
+
+
+def _assert_fast_matches_reference(trees, X, nf, k=1):
+    ref = S.predict_contrib_trees_reference(trees, X, nf, k)
+    fast = S.predict_contrib_trees_fast(trees, X, nf, k)
+    np.testing.assert_allclose(fast, ref, rtol=1e-9, atol=1e-11)
+
+
+def test_fast_shap_numeric():
+    rng = np.random.RandomState(0)
+    n, F = 800, 6
+    X = rng.normal(size=(n, F))
+    y = X[:, 0] + 0.7 * X[:, 1] * X[:, 2] + 0.1 * rng.normal(size=n)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "min_data_in_leaf": 20, "verbosity": -1},
+                  lgb.Dataset(X, label=y), 10)
+    _assert_fast_matches_reference(_model_trees(b), X[:300], F)
+
+
+def test_fast_shap_deep_trees_repeated_features():
+    """Deep trees on few features force repeated features along paths —
+    the duplicate-merge (unwind-and-re-extend) semantics."""
+    rng = np.random.RandomState(1)
+    n, F = 2000, 3
+    X = rng.normal(size=(n, F))
+    y = np.sin(3 * X[:, 0]) + 0.5 * np.sign(X[:, 1]) * X[:, 2]
+    b = lgb.train({"objective": "regression", "num_leaves": 63,
+                   "min_data_in_leaf": 5, "verbosity": -1},
+                  lgb.Dataset(X, label=y), 5)
+    trees = _model_trees(b)
+    # confirm at least one path actually repeats a feature
+    has_repeat = any(
+        len(feats) < sum(len(sp) for sp in splits)
+        for t in trees for feats, _, splits in S._leaf_paths(t))
+    assert has_repeat
+    _assert_fast_matches_reference(trees, X[:200], F)
+
+
+def test_fast_shap_nan_and_categorical():
+    rng = np.random.RandomState(2)
+    n, F = 1500, 5
+    X = rng.normal(size=(n, F))
+    X[:, 3] = rng.randint(0, 8, size=n)             # categorical
+    X[rng.rand(n) < 0.2, 1] = np.nan                # missing values
+    y = (X[:, 0] + (X[:, 3] > 3) + np.where(np.isnan(X[:, 1]), 0.5,
+                                            X[:, 1]))
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "min_data_in_leaf": 20, "verbosity": -1,
+                   "categorical_feature": [3]},
+                  lgb.Dataset(X, label=y,
+                              categorical_feature=[3]), 8)
+    _assert_fast_matches_reference(_model_trees(b), X[:300], F)
+
+
+def test_fast_shap_multiclass_layout():
+    rng = np.random.RandomState(3)
+    n, F, K = 900, 4, 3
+    X = rng.normal(size=(n, F))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int) + (X[:, 2] > 1)
+    b = lgb.train({"objective": "multiclass", "num_class": K,
+                   "num_leaves": 7, "min_data_in_leaf": 20,
+                   "verbosity": -1},
+                  lgb.Dataset(X, label=y.astype(float)), 5)
+    trees = _model_trees(b)
+    Xs = X[:150]
+    ref = S.predict_contrib_trees_reference(trees, Xs, F, K)
+    fast = S.predict_contrib_trees_fast(trees, Xs, F, K)
+    np.testing.assert_allclose(fast, ref, rtol=1e-9, atol=1e-11)
+    # contribs per class block sum to that class's raw score
+    raw = b.predict(Xs, raw_score=True)
+    sums = fast.reshape(len(Xs), K, F + 1).sum(axis=2)
+    np.testing.assert_allclose(sums, raw, rtol=1e-6, atol=1e-8)
+
+
+def test_fast_shap_booster_predict_path():
+    """Booster.predict(pred_contrib=True) routes through the fast path and
+    still satisfies the sums-to-raw-prediction contract."""
+    rng = np.random.RandomState(4)
+    n, F = 600, 5
+    X = rng.normal(size=(n, F))
+    y = (X[:, 0] - X[:, 2] > 0).astype(float)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "min_data_in_leaf": 20, "verbosity": -1},
+                  lgb.Dataset(X, label=y), 10)
+    contrib = b.predict(X[:100], pred_contrib=True)
+    raw = b.predict(X[:100], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_fast_shap_f32_mode(monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_SHAP_DTYPE", "float32")
+    rng = np.random.RandomState(5)
+    n, F = 500, 4
+    X = rng.normal(size=(n, F))
+    y = X[:, 0] + 0.3 * X[:, 1]
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "min_data_in_leaf": 20, "verbosity": -1},
+                  lgb.Dataset(X, label=y), 8)
+    trees = _model_trees(b)
+    ref = S.predict_contrib_trees_reference(trees, X[:200], F)
+    fast = S.predict_contrib_trees_fast(trees, X[:200], F)
+    np.testing.assert_allclose(fast, ref, rtol=3e-5, atol=3e-6)
+
+
+def test_bucket_ceiling_beyond_table():
+    assert S._bucket_ceiling(1) == 2
+    assert S._bucket_ceiling(256) == 256
+    assert S._bucket_ceiling(257) == 320
+    assert S._bucket_ceiling(500) == 512
+
+
+def test_fast_shap_outer_row_blocks(monkeypatch):
+    """Parity is preserved across the outer decision-block boundary."""
+    monkeypatch.setattr(S, "_DEC_ROW_BLOCK_MAX", 100)
+    monkeypatch.setattr(S, "_dec_row_block", lambda total_nodes: 100)
+    rng = np.random.RandomState(6)
+    n, F = 350, 4
+    X = rng.normal(size=(n, F))
+    y = X[:, 0] - 0.4 * X[:, 2]
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "min_data_in_leaf": 20, "verbosity": -1},
+                  lgb.Dataset(X, label=y), 6)
+    trees = _model_trees(b)
+    _assert_fast_matches_reference(trees, X, F)
+
+
+def test_pred_contrib_after_rollback_not_stale():
+    """rollback_one_iter + retrain must invalidate the contrib tree cache
+    (same tree count, different last tree)."""
+    rng = np.random.RandomState(7)
+    n, F = 400, 4
+    X = rng.normal(size=(n, F))
+    y = X[:, 0] + 0.5 * X[:, 1]
+    b = lgb.train({"objective": "regression", "num_leaves": 7,
+                   "min_data_in_leaf": 20, "verbosity": -1},
+                  lgb.Dataset(X, label=y), 5,
+                  keep_training_booster=True)
+    c_before = b.predict(X[:50], pred_contrib=True)
+    b._boosting.rollback_one_iter()
+    # retrain one iteration -> a different (post-rollback-state) 5th tree
+    b.update()
+    c_after = b.predict(X[:50], pred_contrib=True)
+    raw = b.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(c_after.sum(axis=1), raw,
+                               rtol=1e-6, atol=1e-8)
