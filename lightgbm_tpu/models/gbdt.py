@@ -217,16 +217,24 @@ class GBDT:
         if self._with_monotone:
             method = cfg.monotone_constraints_method
             if method in ("intermediate", "advanced"):
-                self._mono_mode = "intermediate"
-                if method == "advanced":
-                    log.warning("monotone_constraints_method=advanced is not"
-                                " implemented; falling back to intermediate")
+                self._mono_mode = method
+                if method == "advanced" and cfg.tree_learner == "feature":
+                    # the per-threshold bound tensors span the GLOBAL
+                    # feature axis; under feature slicing fall back to the
+                    # leaf-level intermediate bounds
+                    log.warning("monotone_constraints_method=advanced is "
+                                "not supported with tree_learner=feature; "
+                                "using intermediate")
+                    self._mono_mode = "intermediate"
                 # exact output bounds are recomputed from all leaf outputs
                 # each phase, which requires strict one-split-per-phase
                 # growth (matching the reference's re-search-after-update,
                 # monotone_constraints.hpp:565)
-                log.info("monotone intermediate mode: strict leaf-wise "
-                         "growth order enabled")
+                log.warning(
+                    f"monotone_constraints_method={self._mono_mode} forces "
+                    "strict one-split-per-phase growth: one histogram round "
+                    "per split, ~num_leaves/log2(num_leaves) x the batched "
+                    "mode's data passes (use 'basic' for speed)")
             elif method not in ("basic",):
                 log.warning(f"monotone_constraints_method={method} is not "
                             f"implemented; falling back to basic")
@@ -1162,14 +1170,17 @@ class GBDT:
         else:
             end_iter = min(start_iteration + num_iteration, total_iters)
         mappers = self.train_set.mappers
-        # reuse the converted ModelTree list across calls (stable object
-        # identities also let the SHAP stack cache skip its precompute)
+        # reuse the converted ModelTree lists across calls, keyed by the
+        # iteration window so alternating truncated/full pred_contrib calls
+        # don't thrash (stable object identities also let the SHAP stack
+        # cache skip its precompute)
         cache_key = (start_iteration, end_iter, len(self.trees),
                      self.loaded_iters)
-        cached = getattr(self, "_contrib_tree_cache", None)
-        if cached is not None and cached[0] == cache_key:
-            trees = cached[1]
-        else:
+        cache = getattr(self, "_contrib_tree_cache", None)
+        if cache is None:
+            cache = self._contrib_tree_cache = {}
+        trees = cache.get(cache_key)
+        if trees is None:
             trees = []
             for it in range(start_iteration, end_iter):
                 for c in range(k):
@@ -1179,7 +1190,10 @@ class GBDT:
                         trees.append(ModelTree.from_host(
                             self.host_trees[(it - self.loaded_iters) * k + c],
                             mappers))
-            self._contrib_tree_cache = (cache_key, trees)
+            if len(cache) >= 8:
+                cache.pop(next(iter(cache)))
+            cache[cache_key] = trees
+
         return predict_contrib_trees(trees, X,
                                      self.train_set.num_total_features, k,
                                      average=self.average_output)

@@ -66,6 +66,156 @@ NEG_INF = -jnp.inf
 F32_MAX = jnp.finfo(jnp.float32).max
 
 
+def advanced_child_bounds(lo, hi, out, act, monotone, num_bins: int,
+                          mono_features: tuple):
+    """Per-threshold child output bounds for the ADVANCED monotone mode.
+
+    For a split of leaf ``l`` on feature ``g`` at threshold bin ``t``, the
+    left child occupies the slice ``[lo[l,g], t]`` of l's region box and
+    the right child ``[t+1, hi[l,g]]``. A leaf ``l'`` bounds a child when
+    it overlaps the child's region in every feature except exactly one
+    monotone feature where it lies strictly on one side — the same
+    contiguity relation the intermediate mode applies to whole boxes,
+    refined to the child region. This is the vectorized re-derivation of
+    the reference's threshold-sliced constraints
+    (monotone_constraints.hpp:856-1171 AdvancedLeafConstraints:
+    GoUp/GoDownToFindConstrainingLeaves build FeatureMinOrMaxConstraints
+    over threshold slices whose CumulativeFeatureConstraint left/right
+    extrema equal these arrays at each t).
+
+    Every contribution is monotone in t (a leaf starts or stops
+    constraining at one breakpoint bin), so bounds assemble as
+    scatter-extremum at the breakpoints followed by prefix/suffix
+    cumulative extrema over the bin axis.
+
+    Args:
+      lo, hi: [L, F] int32 inclusive leaf region boxes in bin space.
+      out: [L] current leaf outputs.
+      act: [L] bool active leaves.
+      monotone: [F] int8 per-feature direction.
+      num_bins: static B (threshold axis length).
+      mono_features: static tuple of monotone feature indices.
+
+    Returns:
+      (lmin, lmax, rmin, rmax): [L, F, B] f32 output bounds for the
+      left/right child as a function of threshold bin.
+    """
+    L, F = lo.shape
+    B = num_bins
+    NEG = jnp.float32(-F32_MAX)
+    POS = jnp.float32(F32_MAX)
+    outf = out.astype(jnp.float32)
+    size = L * F * B
+    li = jnp.arange(L, dtype=jnp.int32)
+
+    ovl = ((lo[:, None, :] <= hi[None, :, :])
+           & (lo[None, :, :] <= hi[:, None, :]))          # [L, L', F]
+    cnt = jnp.sum(ovl, axis=2, dtype=jnp.int32)           # [L, L']
+    pair = act[:, None] & act[None, :] & ~jnp.eye(L, dtype=bool)
+
+    # scatter planes: pre_* activates for t >= tau (prefix extremum),
+    # suf_* for t <= tau (suffix extremum)
+    pre_lmin = jnp.full((size,), NEG)
+    suf_lmin = jnp.full((size,), NEG)
+    pre_lmax = jnp.full((size,), POS)
+    suf_lmax = jnp.full((size,), POS)
+    pre_rmin = jnp.full((size,), NEG)
+    pre_rmax = jnp.full((size,), POS)
+    suf_rmin = jnp.full((size,), NEG)
+    suf_rmax = jnp.full((size,), POS)
+
+    val2 = jnp.broadcast_to(outf[None, :], (L, L))
+
+    # ---- case A: the separating monotone feature IS the split feature g.
+    # l' must overlap l in every other feature; its position relative to
+    # the child SLICE in g decides the bound and the breakpoint.
+    for m in mono_features:
+        caseA = pair & (cnt - ovl[:, :, m].astype(jnp.int32) == F - 1)
+        mpos = monotone[m] > 0
+        base_idx = (li[:, None] * F + m) * B
+        # LEFT child, l' strictly above the slice (lo_g(l') > t):
+        # active for t <= lo_g(l') - 1
+        tau = jnp.broadcast_to(lo[None, :, m] - 1, (L, L))
+        idx = jnp.where(caseA & (tau >= 0), base_idx + tau, size)
+        suf_lmax = suf_lmax.at[jnp.where(mpos, idx, size)].min(
+            val2, mode="drop")
+        suf_lmin = suf_lmin.at[jnp.where(mpos, size, idx)].max(
+            val2, mode="drop")
+        # LEFT child, l' strictly below the slice (== below the box,
+        # since the slice shares the box's lower edge): all t
+        belowb = caseA & (hi[None, :, m] < lo[:, None, m])
+        idx0 = jnp.where(belowb, base_idx, size)
+        pre_lmin = pre_lmin.at[jnp.where(mpos, idx0, size)].max(
+            val2, mode="drop")
+        pre_lmax = pre_lmax.at[jnp.where(mpos, size, idx0)].min(
+            val2, mode="drop")
+        # RIGHT child, l' strictly below the slice (hi_g(l') <= t):
+        # active for t >= hi_g(l')
+        taur = jnp.broadcast_to(hi[None, :, m], (L, L))
+        idxr = jnp.where(caseA, base_idx + taur, size)
+        pre_rmin = pre_rmin.at[jnp.where(mpos, idxr, size)].max(
+            val2, mode="drop")
+        pre_rmax = pre_rmax.at[jnp.where(mpos, size, idxr)].min(
+            val2, mode="drop")
+        # RIGHT child, l' strictly above the slice (== above the box): all t
+        aboveb = caseA & (lo[None, :, m] > hi[:, None, m])
+        idx0r = jnp.where(aboveb, base_idx, size)
+        pre_rmax = pre_rmax.at[jnp.where(mpos, idx0r, size)].min(
+            val2, mode="drop")
+        pre_rmin = pre_rmin.at[jnp.where(mpos, size, idx0r)].max(
+            val2, mode="drop")
+
+    # ---- case B: the separator is a monotone feature m* != g; the
+    # t-dependence enters through l' overlapping the child's g-slice.
+    Bmin = jnp.zeros((L, L, F), bool)
+    Bmax = jnp.zeros((L, L, F), bool)
+    for m in mono_features:
+        above = lo[None, :, m] > hi[:, None, m]
+        below = hi[None, :, m] < lo[:, None, m]
+        okF = ((cnt[:, :, None] - ovl.astype(jnp.int32)
+                - ovl[:, :, m].astype(jnp.int32)[:, :, None]) == F - 2)
+        okF = okF & (pair & (above | below))[:, :, None]
+        okF = okF.at[:, :, m].set(False)          # m* == g handled by case A
+        mpos = monotone[m] > 0
+        is_min = jnp.where(mpos, below, above)[:, :, None]
+        Bmin = Bmin | (okF & is_min)
+        Bmax = Bmax | (okF & ~is_min)
+
+    gidx = jnp.arange(F, dtype=jnp.int32)
+    base3 = (li[:, None, None] * F + gidx[None, None, :]) * B    # [L, 1, F]
+    val3 = jnp.broadcast_to(outf[None, :, None], (L, L, F))
+    # LEFT child: needs hi_g(l') >= lo_g(l); active for t >= lo_g(l')
+    okL = hi[None, :, :] >= lo[:, None, :]
+    tauL = jnp.clip(jnp.broadcast_to(lo[None, :, :], (L, L, F)), 0, B - 1)
+    idxL_min = jnp.where(Bmin & okL, base3 + tauL, size)
+    idxL_max = jnp.where(Bmax & okL, base3 + tauL, size)
+    pre_lmin = pre_lmin.at[idxL_min].max(val3, mode="drop")
+    pre_lmax = pre_lmax.at[idxL_max].min(val3, mode="drop")
+    # RIGHT child: needs lo_g(l') <= hi_g(l); active for t <= hi_g(l') - 1
+    okR = lo[None, :, :] <= hi[:, None, :]
+    tauR = jnp.broadcast_to(hi[None, :, :] - 1, (L, L, F))
+    okR = okR & (tauR >= 0)
+    idxR_min = jnp.where(Bmin & okR, base3 + tauR, size)
+    idxR_max = jnp.where(Bmax & okR, base3 + tauR, size)
+    suf_rmin = suf_rmin.at[idxR_min].max(val3, mode="drop")
+    suf_rmax = suf_rmax.at[idxR_max].min(val3, mode="drop")
+
+    def shape(x):
+        return x.reshape(L, F, B)
+
+    cmax = functools.partial(jax.lax.cummax, axis=2)
+    cmin = functools.partial(jax.lax.cummin, axis=2)
+    lmin = jnp.maximum(cmax(shape(pre_lmin)),
+                       cmax(shape(suf_lmin), reverse=True))
+    lmax = jnp.minimum(cmin(shape(pre_lmax)),
+                       cmin(shape(suf_lmax), reverse=True))
+    rmin = jnp.maximum(cmax(shape(pre_rmin)),
+                       cmax(shape(suf_rmin), reverse=True))
+    rmax = jnp.minimum(cmin(shape(pre_rmax)),
+                       cmin(shape(suf_rmax), reverse=True))
+    return lmin, lmax, rmin, rmax
+
+
 class GrowAux(NamedTuple):
     """Cross-iteration learner state returned alongside the tree (CEGB's
     feature-used tracking is global across the boosting run,
@@ -493,7 +643,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                      jnp.float32(0.0))
 
     iota_l = jnp.arange(L, dtype=jnp.int32)
-    mono_intermediate = with_monotone and mono_mode == "intermediate"
+    # "intermediate" and "advanced" both maintain leaf region boxes and
+    # recompute exact bounds each phase; "advanced" additionally derives
+    # per-threshold child bounds for the numerical search
+    mono_intermediate = with_monotone and mono_mode in ("intermediate",
+                                                        "advanced")
+    mono_advanced = with_monotone and mono_mode == "advanced"
     # intermediate-mode constraints are recomputed from ALL current leaf
     # outputs at the start of each split phase, so the strict one-split-per-
     # phase order is required for soundness (the reference re-searches the
@@ -718,8 +873,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                               leaf_max=ub.astype(state.leaf_max.dtype))
 
     def split_phase(state: GrowState) -> GrowState:
+        adv = None
         if mono_intermediate:
             state = intermediate_bounds(state)
+            if mono_advanced:
+                adv = advanced_child_bounds(
+                    state.leaf_lo, state.leaf_hi, state.leaf_output,
+                    active_mask(state), meta.monotone, num_bins,
+                    mono_features)
         round_key = jax.random.fold_in(rng_key, state.rounds)
         fmask = slice_f(leaf_feature_mask(state, round_key))
         rand_bin = None
@@ -780,6 +941,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             with_categorical=with_categorical, cat_words=cat_words,
             leaf_min=state.leaf_min if with_monotone else None,
             leaf_max=state.leaf_max if with_monotone else None,
+            adv_bounds=adv,
             gain_adjust=slice_f(cegb_adjust(state)),
             rand_bin=rand_bin, bundle=bundle_s)
         if fp_mode:
@@ -832,8 +994,14 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         restricted to the forced bin and min_gain disabled, so sums and
         missing/default semantics are exact; a forced split its constraints
         reject is skipped along with its whole subtree."""
+        adv = None
         if mono_intermediate:
             state = intermediate_bounds(state)
+            if mono_advanced:
+                adv = advanced_child_bounds(
+                    state.leaf_lo, state.leaf_hi, state.leaf_output,
+                    active_mask(state), meta.monotone, num_bins,
+                    mono_features)
         ff, ft, fl, fr = forced_splits
         k_idx = state.forced_idx
         l = state.forced_slot[k_idx]
@@ -858,6 +1026,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             with_categorical=False, cat_words=cat_words,
             leaf_min=state.leaf_min if with_monotone else None,
             leaf_max=state.leaf_max if with_monotone else None,
+            adv_bounds=adv,
             rand_bin=jnp.full((L, f_loc), ft[k_idx], jnp.int32),
             bundle=bundle_s)
         if fp_mode:

@@ -492,6 +492,7 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
                      with_categorical: bool = False,
                      cat_words: int = CAT_BITSET_WORDS,
                      leaf_min=None, leaf_max=None,
+                     adv_bounds=None,
                      gain_adjust=None, rand_bin=None,
                      bundle: BundleMeta | None = None,
                      return_feature_gains: bool = False):
@@ -508,6 +509,11 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
         candidate outputs are clipped and monotone-violating candidates
         rejected (reference: feature_histogram.hpp:766-824 GetSplitGains
         with USE_MC + BasicConstraint clip).
+      adv_bounds: optional (lmin, lmax, rmin, rmax) [L, F, B] per-threshold
+        child output bounds for the ADVANCED monotone mode (reference:
+        CumulativeFeatureConstraint Get{Left,Right}{Min,Max} per threshold,
+        monotone_constraints.hpp:144-259); overrides the [L] clip for the
+        numerical search.
       gain_adjust: [L, F] additive penalty subtracted from the stored gain
         (the CEGB delta, cost_effective_gradient_boosting.hpp:66-84).
       rand_bin: [L, F] int32 forced random threshold for extra_trees
@@ -531,18 +537,23 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
 
     parent_out = leaf_output[:, None, None]
 
-    use_mc = leaf_min is not None
+    use_mc = leaf_min is not None or adv_bounds is not None
 
-    def clip_out(out):
-        if not use_mc:
+    def clip_out(out, side):
+        if adv_bounds is not None:
+            lmin_a, lmax_a, rmin_a, rmax_a = adv_bounds
+            mn, mx = ((lmin_a, lmax_a) if side == "left"
+                      else (rmin_a, rmax_a))
+            return jnp.clip(out, mn, mx)
+        if leaf_min is None:
             return out
         return jnp.clip(out, leaf_min[:, None, None], leaf_max[:, None, None])
 
     def split_gain_dir(prefix):
         lg, lh, lc = s[f"{prefix}_left_g"], s[f"{prefix}_left_h"], s[f"{prefix}_left_c"]
         rg, rh, rc = s[f"{prefix}_right_g"], s[f"{prefix}_right_h"], s[f"{prefix}_right_c"]
-        lo = clip_out(calculate_leaf_output(lg, lh, p, lc, parent_out))
-        ro = clip_out(calculate_leaf_output(rg, rh, p, rc, parent_out))
+        lo = clip_out(calculate_leaf_output(lg, lh, p, lc, parent_out), "left")
+        ro = clip_out(calculate_leaf_output(rg, rh, p, rc, parent_out), "right")
         gain = (leaf_gain_given_output(lg, lh, lo, p)
                 + leaf_gain_given_output(rg, rh, ro, p))
         if use_mc:
@@ -653,7 +664,12 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
 
     left_out = calculate_leaf_output(left_g, left_h, p, left_c, leaf_output)
     right_out = calculate_leaf_output(right_g, right_h, p, right_c, leaf_output)
-    if use_mc:
+    if adv_bounds is not None:
+        lmin_a, lmax_a, rmin_a, rmax_a = adv_bounds
+        left_out = jnp.clip(left_out, lmin_a[li, bf, bt], lmax_a[li, bf, bt])
+        right_out = jnp.clip(right_out, rmin_a[li, bf, bt],
+                             rmax_a[li, bf, bt])
+    elif use_mc:
         left_out = jnp.clip(left_out, leaf_min, leaf_max)
         right_out = jnp.clip(right_out, leaf_min, leaf_max)
 

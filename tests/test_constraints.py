@@ -247,3 +247,143 @@ def test_monotone_intermediate_beats_basic():
     for x1 in (-1.0, 1.0):
         g[:, 1] = x1
         assert np.all(np.diff(b_inter.predict(g)) >= -1e-6)
+
+
+def test_advanced_child_bounds_match_bruteforce_oracle():
+    """advanced_child_bounds vs a brute-force oracle applying the
+    slice-contiguity definition directly: l' bounds a child region when it
+    overlaps the region in every feature except exactly one monotone
+    feature where it lies strictly on one side (the semantics of the
+    reference's AdvancedLeafConstraints threshold-sliced constraints,
+    monotone_constraints.hpp:856-1171)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.models.grower import advanced_child_bounds, F32_MAX
+
+    rng = np.random.RandomState(3)
+    F, B = 4, 16
+    monotone = np.array([1, -1, 0, 1], np.int8)
+    mono_features = (0, 1, 3)
+
+    # build leaf boxes by random axis-aligned splits of the bin space
+    boxes = [(np.zeros(F, np.int64), np.full(F, B - 1, np.int64))]
+    for _ in range(12):
+        i = rng.randint(len(boxes))
+        lo, hi = boxes[i]
+        g = rng.randint(F)
+        if hi[g] <= lo[g]:
+            continue
+        t = rng.randint(lo[g], hi[g])          # split bin in [lo, hi-1]
+        llo, lhi = lo.copy(), hi.copy()
+        rlo, rhi = lo.copy(), hi.copy()
+        lhi[g] = t
+        rlo[g] = t + 1
+        boxes[i] = (llo, lhi)
+        boxes.append((rlo, rhi))
+    L = 16
+    lo = np.zeros((L, F), np.int32)
+    hi = np.full((L, F), B - 1, np.int32)
+    act = np.zeros(L, bool)
+    for i, (blo, bhi) in enumerate(boxes):
+        lo[i], hi[i] = blo, bhi
+        act[i] = True
+    out = rng.normal(size=L)
+
+    lmin, lmax, rmin, rmax = (np.asarray(a) for a in advanced_child_bounds(
+        jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(out, jnp.float32),
+        jnp.asarray(act), jnp.asarray(monotone), B, mono_features))
+
+    def oracle(l, g, t, side):
+        # child region of leaf l after splitting feature g at bin t
+        rlo, rhi = lo[l].copy(), hi[l].copy()
+        if side == "left":
+            rhi[g] = t
+        else:
+            rlo[g] = t + 1
+        mn, mx = -np.inf, np.inf
+        for lp in range(L):
+            if not act[lp] or lp == l:
+                continue
+            seps = []
+            ok = True
+            for f2 in range(F):
+                overlap = lo[lp, f2] <= rhi[f2] and rlo[f2] <= hi[lp, f2]
+                if not overlap:
+                    if monotone[f2] == 0:
+                        ok = False
+                        break
+                    seps.append(f2)
+            if not ok or len(seps) != 1:
+                continue
+            m = seps[0]
+            below = hi[lp, m] < rlo[m]
+            if (monotone[m] > 0) == below:
+                mn = max(mn, out[lp])
+            else:
+                mx = min(mx, out[lp])
+        return mn, mx
+
+    checked = 0
+    for l in range(L):
+        if not act[l]:
+            continue
+        for g in range(F):
+            for t in range(lo[l, g], hi[l, g]):      # valid split bins
+                omn, omx = oracle(l, g, t, "left")
+                vmn = lmin[l, g, t] if lmin[l, g, t] > -F32_MAX / 2 else -np.inf
+                vmx = lmax[l, g, t] if lmax[l, g, t] < F32_MAX / 2 else np.inf
+                assert np.isclose(vmn, omn, rtol=1e-6) or (
+                    np.isinf(omn) and np.isinf(vmn)), (l, g, t, vmn, omn)
+                assert np.isclose(vmx, omx, rtol=1e-6) or (
+                    np.isinf(omx) and np.isinf(vmx)), (l, g, t, vmx, omx)
+                omn, omx = oracle(l, g, t, "right")
+                vmn = rmin[l, g, t] if rmin[l, g, t] > -F32_MAX / 2 else -np.inf
+                vmx = rmax[l, g, t] if rmax[l, g, t] < F32_MAX / 2 else np.inf
+                assert np.isclose(vmn, omn, rtol=1e-6) or (
+                    np.isinf(omn) and np.isinf(vmn)), ("R", l, g, t, vmn, omn)
+                assert np.isclose(vmx, omx, rtol=1e-6) or (
+                    np.isinf(omx) and np.isinf(vmx)), ("R", l, g, t, vmx, omx)
+                checked += 2
+    assert checked > 200
+
+
+def test_monotone_advanced_enforced(reg_data):
+    X, y = reg_data
+    params = dict(objective="regression", num_leaves=15,
+                  min_data_in_leaf=20, verbosity=-1,
+                  monotone_constraints=[1, -1, 0, 0],
+                  monotone_constraints_method="advanced")
+    b = lgb.train(params, lgb.Dataset(X, label=y), 12)
+    rng = np.random.RandomState(0)
+    base = rng.uniform(-1, 1, size=(40, X.shape[1]))
+    grid = np.linspace(-1, 1, 25)
+    for feat, sign in ((0, 1), (1, -1)):
+        preds = []
+        for g in grid:
+            Xg = base.copy()
+            Xg[:, feat] = g
+            preds.append(b.predict(Xg))
+        d = np.diff(np.asarray(preds), axis=0) * sign
+        assert (d >= -1e-10).all(), (feat, float(d.min()))
+
+
+def test_monotone_advanced_at_least_intermediate():
+    """Advanced (threshold-sliced) constraints are never more restrictive
+    than intermediate leaf-level bounds in aggregate: the fit should be at
+    least as good on a monotone-constrained problem."""
+    rng = np.random.RandomState(11)
+    n = 2500
+    X = rng.uniform(-1, 1, size=(n, 4))
+    y = (2 * X[:, 0] - 1.5 * X[:, 1] + np.sin(3 * X[:, 2])
+         + 0.1 * rng.normal(size=n))
+
+    def fit(method):
+        b = lgb.train({"objective": "regression", "num_leaves": 31,
+                       "min_data_in_leaf": 20, "verbosity": -1,
+                       "monotone_constraints": [1, -1, 0, 0],
+                       "monotone_constraints_method": method},
+                      lgb.Dataset(X, label=y), 25)
+        return float(np.mean((b.predict(X) - y) ** 2))
+
+    mse_inter = fit("intermediate")
+    mse_adv = fit("advanced")
+    assert mse_adv <= mse_inter * 1.02, (mse_adv, mse_inter)
