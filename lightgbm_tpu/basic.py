@@ -671,7 +671,18 @@ class Dataset:
         dense_bin.hpp)."""
         self.construct()
         if getattr(self, "_bins_T", None) is None:
-            self._bins_T = jnp.asarray(self.bins.T)
+            if getattr(self, "is_pre_partitioned", False):
+                # global row-sharded bins: transpose as an SPMD program
+                # with an explicit output sharding (every process reaches
+                # this property in lockstep during training)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                sh = self.bins.sharding
+                self._bins_T = jax.jit(
+                    lambda b: b.T,
+                    out_shardings=NamedSharding(
+                        sh.mesh, P(None, sh.spec[0])))(self.bins)
+            else:
+                self._bins_T = jnp.asarray(self.bins.T)
         return self._bins_T
 
     def num_used_features(self) -> int:

@@ -225,6 +225,20 @@ def maybe_init_from_config(config) -> None:
         init(num_machines=nm, params=config)
 
 
+def allgather_f64(arr):
+    """``process_allgather`` that PRESERVES float64 bits by gathering the
+    raw bytes: with jax x64 disabled, a plain allgather round-trips
+    through f32 device arrays and truncates. Returns [nproc, *arr.shape].
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+    a = np.ascontiguousarray(np.asarray(arr, np.float64))
+    g = np.ascontiguousarray(np.asarray(
+        multihost_utils.process_allgather(a.view(np.uint8))))
+    return g.reshape((-1,) + a.shape[:-1]
+                     + (a.shape[-1] * 8,)).view(np.float64)
+
+
 # ------------------------------------------------ distributed data loading
 def load_partitioned(data, label=None, weight=None, init_score=None,
                      params: Optional[dict] = None,
@@ -245,9 +259,15 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
 
     Returns a constructed ``Dataset`` whose ``bins`` is a global jax.Array
     sharded over processes; ``num_data`` is the GLOBAL row count while
-    label/weight stay process-local. Use with ``ParallelGrower`` /
-    ``tree_learner="data"`` at the grower level; full Booster integration
-    over local scores is the remaining step.
+    label/weight stay process-local. Pass it straight to ``lgb.train`` /
+    ``Booster`` with ``tree_learner="data"`` (or voting): scores,
+    gradients and the leaf-id vector all stay process-local / row-sharded
+    through the whole boosting loop (the reference's per-machine score
+    partition, score_updater.hpp — memory per machine FALLS as machines
+    are added, docs/Experiments.rst:228-242), with EFB bundling and the
+    feature-major fast path both active. Metrics evaluate on each
+    process's local partition, like the reference's per-machine metric
+    logs. Not supported: dart, linear_tree, rollback_one_iter.
     """
     import jax
     import numpy as np
@@ -279,11 +299,14 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
     else:
         valid_local = np.ones(per_proc, bool)
     if nproc > 1:
-        gathered = multihost_utils.process_allgather(sample_local)
-        valid = multihost_utils.process_allgather(valid_local).reshape(-1)
+        # bit-exact f64 sample gather (a plain allgather truncates to f32
+        # with x64 off, making bin bounds differ from a 1-process run)
+        gathered = allgather_f64(sample_local)
+        valid = np.asarray(
+            multihost_utils.process_allgather(valid_local)).reshape(-1)
         sample = gathered.reshape(-1, f)[valid]
-        local_counts = multihost_utils.process_allgather(
-            np.asarray([n_local]))
+        local_counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([n_local], np.int32)))
         n_global = int(local_counts.sum())
     else:
         sample = sample_local[valid_local]
@@ -313,12 +336,21 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
     ds.num_data = n_global
     ds.num_total_features = f
     ds._feature_names = names
-    ds.bundles = None
-    ds._build_feature_meta(config)
-    used = [mappers[j] for j in ds.used_features]
-    local_bins = binning.bin_data(
-        X[:, ds.used_features] if len(ds.used_features)
-        else np.zeros((n_local, 0)), used)
+    # EFB over the agreed (allgathered) sample: identical inputs on every
+    # process -> identical bundle assignment, so the bundled column layout
+    # needs no further cross-host negotiation (the analog of the
+    # reference's sample-driven FastFeatureBundling, dataset.cpp:239)
+    ds._run_bundling(sample, len(sample), config)
+    if ds.bundles is not None and len(ds.bundles):
+        ds._build_feature_meta_bundled(config)
+        local_bins = ds._bin_columns(X)
+    else:
+        ds.bundles = None
+        ds._build_feature_meta(config)
+        used = [mappers[j] for j in ds.used_features]
+        local_bins = binning.bin_data(
+            X[:, ds.used_features] if len(ds.used_features)
+            else np.zeros((n_local, 0)), used)
     dtype = np.uint8 if ds.max_num_bins <= 256 else np.int32
     local_bins = local_bins.astype(dtype)
     # pad local rows to a common per-process count divisible by the local
@@ -337,10 +369,17 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
             local_bins, mesh, P("shard", None))
     else:
         ds.bins = jax.device_put(jax.numpy.asarray(local_bins), sharding)
+    # the feature-major copy (doubles the dominant array) is built LAZILY
+    # by the prepart-aware Dataset.bins_T property, so histogram methods
+    # that never read it (scatter/binloop) pay nothing
     ds.raw_data_np = None
     ds.is_pre_partitioned = True
     ds.num_local_data = n_local
     ds._constructed = True
+    if ds.free_raw_data:
+        ds.data = None
+    g = ds.num_used_features()
     log.info(f"pre-partitioned dataset: {n_local} local rows of "
-             f"{n_global} global, {len(ds.used_features)} used features")
+             f"{n_global} global, {len(ds.used_features)} used features"
+             + (f" (bundled into {g} columns)" if ds.bundles else ""))
     return ds

@@ -113,6 +113,17 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         period = 1 if verbose_eval is True else verbose_eval
         cbs.add(callback_mod.print_evaluation(period))
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        import jax
+        if (getattr(train_set, "is_pre_partitioned", False)
+                and jax.process_count() > 1):
+            # metrics evaluate on each process's LOCAL partition (the
+            # reference's per-machine metric semantics): local values
+            # differ, so per-process stopping decisions would desync the
+            # SPMD collectives and hang
+            log.fatal("early_stopping_rounds is not supported with "
+                      "multi-process pre-partitioned training: metrics "
+                      "are per-process local, so stopping decisions would "
+                      "diverge across processes")
         cbs.add(callback_mod.early_stopping(early_stopping_rounds, first_metric_only))
     if evals_result is not None:
         cbs.add(callback_mod.record_evaluation(evals_result))

@@ -42,6 +42,12 @@ class DART(GBDT):
     def __init__(self, config: Config, train_set: Optional[Dataset] = None,
                  objective: Optional[ObjectiveFunction] = None):
         super().__init__(config, train_set, objective)
+        if getattr(self, "_pre_part", False):
+            # drop/normalize re-traverses the train bins, which are
+            # globally sharded here; per-shard traversal is not wired up
+            from ..utils import log as _log
+            _log.fatal("boosting=dart is not supported with "
+                       "pre-partitioned Datasets")
         self._drop_rng = np.random.RandomState(config.drop_seed)
         self.tree_weight: List[float] = []   # per-iteration weights (dart.hpp:201)
         self.sum_weight = 0.0

@@ -135,13 +135,21 @@ class GBDT:
     # ------------------------------------------------------------ setup
     def _init_train(self, train_set: Dataset) -> None:
         train_set.construct()
-        if getattr(train_set, "is_pre_partitioned", False):
-            log.fatal("Booster-level training over a pre-partitioned "
-                      "Dataset is not supported yet: scores/labels are "
-                      "process-local while rows are globally sharded. Use "
-                      "ParallelGrower directly (see "
-                      "distributed.load_partitioned docs)")
         cfg = self.config
+        # pre-partitioned mode (distributed.load_partitioned): bins are a
+        # global row-sharded array; labels/weights/scores/gradients stay
+        # PROCESS-LOCAL (the reference's per-machine score partition,
+        # score_updater.hpp) and only the tree + histograms cross hosts
+        self._pre_part = bool(getattr(train_set, "is_pre_partitioned",
+                                      False))
+        if self._pre_part:
+            if cfg.tree_learner not in ("data", "voting"):
+                log.fatal("pre-partitioned Datasets shard rows: set "
+                          "tree_learner=data or voting")
+            if cfg.linear_tree:
+                log.fatal("linear_tree is not supported with "
+                          "pre-partitioned Datasets (raw features are not "
+                          "retained)")
         self._setup_learner_features(train_set)
         if cfg.linear_tree and self.name in ("dart", "rf"):
             log.fatal(f"linear_tree is not supported with boosting={self.name}")
@@ -161,7 +169,10 @@ class GBDT:
                           f"linear_tree")
         else:
             self.num_tree_per_iteration = max(cfg.num_class, 1)
-        n = train_set.num_data
+        # scores cover the PROCESS-LOCAL rows in pre-partitioned mode
+        n = (train_set.num_local_data if self._pre_part
+             else train_set.num_data)
+        self._n_score_rows = n
         k = self.num_tree_per_iteration
         self._score_shape = (n, k) if k > 1 else (n,)
         # boost_from_average init scores (gbdt.cpp:333-367)
@@ -169,6 +180,19 @@ class GBDT:
         if self.objective is not None and cfg.boost_from_average:
             for c in range(k):
                 self.init_scores[c] = float(self.objective.boost_from_score(c))
+            if self._pre_part and jax.process_count() > 1:
+                # mean of the per-machine local init scores, the
+                # reference's GlobalSyncUpByMean (gbdt.cpp:338-341
+                # ObtainAutomaticInitialScore), bit-exact in f64
+                from ..distributed import allgather_f64
+                all_scores = allgather_f64(np.asarray(self.init_scores))
+                self.init_scores = [float(v)
+                                    for v in all_scores.mean(axis=0)]
+        if (self._pre_part and self.objective is not None
+                and self.objective.need_renew_tree_output):
+            log.warning("pre-partitioned training: L1-style leaf "
+                        "renewal uses each process's local partition "
+                        "(the reference syncs only mean-based renewals)")
         init = train_set.init_score
         # the auto init score is folded as a bias into the first tree of each
         # class (gbdt.cpp:414-416 AddBias) UNLESS a user init score is set
@@ -218,14 +242,6 @@ class GBDT:
             method = cfg.monotone_constraints_method
             if method in ("intermediate", "advanced"):
                 self._mono_mode = method
-                if method == "advanced" and cfg.tree_learner == "feature":
-                    # the per-threshold bound tensors span the GLOBAL
-                    # feature axis; under feature slicing fall back to the
-                    # leaf-level intermediate bounds
-                    log.warning("monotone_constraints_method=advanced is "
-                                "not supported with tree_learner=feature; "
-                                "using intermediate")
-                    self._mono_mode = "intermediate"
                 # exact output bounds are recomputed from all leaf outputs
                 # each phase, which requires strict one-split-per-phase
                 # growth (matching the reference's re-search-after-update,
@@ -399,7 +415,7 @@ class GBDT:
         if not self._need_bagging:
             # bagging switched off mid-training: drop the frozen subset/mask
             self._bag_sub = None
-            self._bag_mask = jnp.ones((self.train_set.num_data,),
+            self._bag_mask = jnp.ones((self._n_score_rows,),
                                       dtype=jnp.float32) \
                 if self.train_set is not None else self._bag_mask
 
@@ -429,7 +445,7 @@ class GBDT:
             return
         if cfg.bagging_freq <= 0 or self.iter % cfg.bagging_freq != 0:
             return
-        n = self.train_set.num_data
+        n = self._n_score_rows
         # subset copy when the fraction is small enough that compacting
         # beats masked full-N histogram passes (the reference's rule,
         # gbdt.cpp:810-818); serial learner, plain fraction only
@@ -514,6 +530,11 @@ class GBDT:
                 tree, leaf_id, aux = self._grow_one(gc, hc, mask, fmask,
                                                     iter_key, hm)
                 grow_scope.sync(tree.num_leaves)
+            # pre-partitioned: leaf_id comes back row-sharded; keep only
+            # this process's rows for the local score update (the
+            # reference's per-machine score partition, score_updater.hpp —
+            # no O(N_global) array is ever materialized per host)
+            leaf_id = self._localize_leaf_id(leaf_id)
             if self._cegb_mode != "off":
                 # CEGB feature-used tracking persists across iterations
                 # (cost_effective_gradient_boosting.hpp Init: !init_ reuse)
@@ -549,6 +570,7 @@ class GBDT:
                 ts.bins, gc, hc, mask,
                 ts.feature_meta, self.split_params, fmask, ts.missing_bin,
                 binsT=ts.bins_T if hm.startswith(("onehot", "pallas")) else None,
+                pre_part=getattr(self, "_pre_part", False),
                 rng_key=iter_key,
                 bundle_meta=ts.bundle_meta,
                 forced_splits=self._forced_splits,
@@ -594,6 +616,19 @@ class GBDT:
             bundle_meta=ts.bundle_meta,
             forced_splits=self._forced_splits,
             hist_dp=self._hist_dp)
+
+    def _localize_leaf_id(self, leaf_id: jax.Array) -> jax.Array:
+        """Pre-partitioned mode: slice this process's rows out of the
+        row-sharded global leaf-id vector (identity otherwise)."""
+        if not getattr(self, "_pre_part", False):
+            return leaf_id
+        n_local = self.train_set.num_local_data
+        if leaf_id.is_fully_addressable:
+            return leaf_id[:n_local]
+        shards = sorted(leaf_id.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        local = np.concatenate([np.asarray(s.data) for s in shards])
+        return jnp.asarray(local[:n_local])
 
     def _hist_method(self) -> str:
         from ..ops.histogram import resolve_method
@@ -881,6 +916,11 @@ class GBDT:
         """reference: gbdt.cpp:454-470 RollbackOneIter."""
         if self.iter <= 0:
             return
+        if getattr(self, "_pre_part", False):
+            # the rollback delta re-traverses the train bins, which are
+            # globally sharded here; per-shard traversal is not wired up
+            log.fatal("rollback_one_iter is not supported with "
+                      "pre-partitioned Datasets")
         k = self.num_tree_per_iteration
         # tree count returns to a previously-seen value after retraining,
         # so the count-keyed contrib cache would serve the popped trees

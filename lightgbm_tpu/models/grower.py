@@ -872,15 +872,24 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return state._replace(leaf_min=lb.astype(state.leaf_min.dtype),
                               leaf_max=ub.astype(state.leaf_max.dtype))
 
+    def adv_bounds_sliced(state: GrowState):
+        """Advanced per-threshold child bounds, built over the GLOBAL
+        feature axis (leaf boxes are global state) then sliced to this
+        shard's owned feature window like every other per-feature input."""
+        adv = advanced_child_bounds(
+            state.leaf_lo, state.leaf_hi, state.leaf_output,
+            active_mask(state), meta.monotone, num_bins, mono_features)
+        if fp_mode:
+            adv = tuple(jax.lax.dynamic_slice_in_dim(a, off, f_loc, 1)
+                        for a in adv)
+        return adv
+
     def split_phase(state: GrowState) -> GrowState:
         adv = None
         if mono_intermediate:
             state = intermediate_bounds(state)
             if mono_advanced:
-                adv = advanced_child_bounds(
-                    state.leaf_lo, state.leaf_hi, state.leaf_output,
-                    active_mask(state), meta.monotone, num_bins,
-                    mono_features)
+                adv = adv_bounds_sliced(state)
         round_key = jax.random.fold_in(rng_key, state.rounds)
         fmask = slice_f(leaf_feature_mask(state, round_key))
         rand_bin = None
@@ -998,10 +1007,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         if mono_intermediate:
             state = intermediate_bounds(state)
             if mono_advanced:
-                adv = advanced_child_bounds(
-                    state.leaf_lo, state.leaf_hi, state.leaf_output,
-                    active_mask(state), meta.monotone, num_bins,
-                    mono_features)
+                adv = adv_bounds_sliced(state)
         ff, ft, fl, fr = forced_splits
         k_idx = state.forced_idx
         l = state.forced_slot[k_idx]

@@ -49,7 +49,7 @@ class RF(GBDT):
         if train_set.init_score is not None:
             log.fatal("Cannot use init_score in RF mode")
         self.shrinkage_rate = 1.0
-        n = train_set.num_data
+        n = self._n_score_rows      # process-local rows when pre-partitioned
         k = self.num_tree_per_iteration
         # score caches start at zero: the init score lives INSIDE the trees
         # as a bias (rf.hpp:135), and scores hold running means of outputs.

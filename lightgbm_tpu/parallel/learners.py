@@ -83,7 +83,8 @@ class ParallelGrower:
         self._cache = {}
         self._global_arrays = {}   # id(host arr) -> (host arr, global arr)
 
-    def _build(self, extras_spec: dict, grow_kwargs: tuple):
+    def _build(self, extras_spec: dict, grow_kwargs: tuple,
+               pre_part: bool = False):
         axis = self.axis
         kw = dict(grow_kwargs)
         if self.mode == "data":
@@ -97,12 +98,15 @@ class ParallelGrower:
         rows_sharded = self.mode in ("data", "voting")
         row = P(axis) if rows_sharded else P()
         row2 = P(axis, None) if rows_sharded else P()
-        # multi-controller: replicate the leaf ids with an in-program
+        # replicated-data multi-controller (every process constructed the
+        # full Dataset): replicate the leaf ids with an in-program
         # all_gather so every process can address the full vector for its
-        # (replicated-data) score update — the per-machine score partition
-        # of the reference (score_updater.hpp) is a later optimization
+        # full-length score update. Pre-partitioned mode keeps leaf_id
+        # ROW-SHARDED end to end — the score update consumes only the
+        # process-local shard (the reference's per-machine score partition,
+        # score_updater.hpp), so no O(N_global) array ever lands on a host
         multiproc = jax.process_count() > 1
-        gather_leaf = multiproc and rows_sharded
+        gather_leaf = multiproc and rows_sharded and not pre_part
 
         def fn(bins, grad, hess, mask, meta, params, fmask, missing_bin,
                extras, rng_key):
@@ -133,56 +137,60 @@ class ParallelGrower:
         globalize once, not once per tree."""
         if arr is None or jax.process_count() == 1:
             return arr
-        if key is not None:
-            hit = self._global_arrays.get(id(key))
-            if hit is not None and hit[0] is key:
-                # LRU: refresh on hit so the per-call working set (up to
-                # ~18 keyed arrays with binsT+bundle+forced) never thrashes
-                self._global_arrays.pop(id(key))
-                self._global_arrays[id(key)] = hit
-                return hit[1]
-        sharding = jax.sharding.NamedSharding(self.mesh, spec)
-        try:
-            # device_put reshards without a host round trip when the input
-            # is already device-resident (the per-tree grad/hess path)
-            out = jax.device_put(arr, sharding)
-        except Exception:
-            host = np.asarray(arr)
-            out = jax.make_array_from_callback(host.shape, sharding,
-                                               lambda idx: host[idx])
-        if key is not None:
-            # keep the source alive so id() stays unique; bounded so a
-            # long-lived process training over many Datasets doesn't pin
-            # every past dataset's host copy
-            if len(self._global_arrays) >= 64:
-                self._global_arrays.pop(next(iter(self._global_arrays)))
-            self._global_arrays[id(key)] = (key, out)
+
+        def build():
+            sharding = jax.sharding.NamedSharding(self.mesh, spec)
+            try:
+                # device_put reshards without a host round trip when the
+                # input is already device-resident (the grad/hess path)
+                return jax.device_put(arr, sharding)
+            except Exception:
+                host = np.asarray(arr)
+                return jax.make_array_from_callback(host.shape, sharding,
+                                                    lambda idx: host[idx])
+
+        return build() if key is None else self._cached_global(key, build)
+
+    def _cached_global(self, key, build):
+        """id()-keyed LRU over dataset-constant globalized arrays (the
+        source object is retained so its id stays unique; bounded so a
+        long-lived process over many Datasets doesn't pin old copies)."""
+        hit = self._global_arrays.get(id(key))
+        if hit is not None and hit[0] is key:
+            self._global_arrays.pop(id(key))
+            self._global_arrays[id(key)] = hit
+            return hit[1]
+        out = build()
+        if len(self._global_arrays) >= 64:
+            self._global_arrays.pop(next(iter(self._global_arrays)))
+        self._global_arrays[id(key)] = (key, out)
         return out
 
     def __call__(self, bins, grad, hess, sample_mask, meta, params,
                  feature_mask, missing_bin, *, binsT=None, rng_key=None,
-                 bundle_meta=None, forced_splits=None, **grow_kwargs):
+                 bundle_meta=None, forced_splits=None, pre_part=None,
+                 **grow_kwargs):
         n, f = bins.shape
         d = self.ndev
         # pre-partitioned mode (distributed.load_partitioned): bins is
         # already a GLOBAL row-sharded array and grad/hess/mask arrive as
-        # this process's LOCAL row slice
-        pre_part = (isinstance(bins, jax.Array)
-                    and not bins.is_fully_addressable)
+        # this process's LOCAL row slice. Callers holding the Dataset pass
+        # the flag explicitly; the addressability probe covers direct
+        # multi-process grower-level use (a 1-process pre-partitioned
+        # array IS fully addressable, so the flag matters there)
+        if pre_part is None:
+            pre_part = (isinstance(bins, jax.Array)
+                        and not bins.is_fully_addressable)
         if pre_part:
             assert self.mode in ("data", "voting"), (
                 "pre-partitioned datasets shard rows; use data/voting")
             assert n % d == 0, (n, d)   # load_partitioned pads rows
-            if binsT is not None or bundle_meta is not None \
-                    or forced_splits is not None:
-                raise NotImplementedError(
-                    "binsT/EFB bundles/forced splits are not supported with "
-                    "pre-partitioned datasets yet")
             # grad/hess/mask arrive as this process's TRUE local rows; pad
             # to the per-process shard size with zero mass
             loc_target = n // max(jax.process_count(), 1)
             row = P(self.axis)
             sharding = jax.sharding.NamedSharding(self.mesh, row)
+            rep = jax.sharding.NamedSharding(self.mesh, P())
 
             def glob(a, fill=0.0):
                 a = np.asarray(a)
@@ -191,39 +199,81 @@ class ParallelGrower:
                                constant_values=fill)
                 return jax.make_array_from_process_local_data(sharding, a)
 
+            def glob_rep(a, key=None):
+                """Replicate a (process-identical) host array globally."""
+                build = lambda: jax.device_put(np.asarray(a), rep)
+                return build() if key is None \
+                    else self._cached_global(key, build)
+
             grad = glob(grad)
             hess = glob(hess)
             sample_mask = glob(sample_mask)
             f_pad = (-f) % d if self.mode == "data" else 0
+            colT = P(None, self.axis)
+
+            def pad_global(arr, spec, fn):
+                """Cached jitted pad of a dataset-constant global array."""
+                out_sh = jax.sharding.NamedSharding(self.mesh, spec)
+                return self._cached_global(
+                    arr, lambda: jax.jit(fn, out_shardings=out_sh)(arr))
+
             if f_pad:
                 meta = _pad_features(meta, f_pad)
                 feature_mask = jnp.pad(feature_mask, (0, f_pad))
                 missing_bin = jnp.pad(missing_bin, (0, f_pad),
                                       constant_values=-1)
-                hit = self._global_arrays.get(id(bins))
-                if hit is not None and hit[0] is bins:
-                    padded = hit[1]
-                else:
-                    pad_sharding = jax.sharding.NamedSharding(
-                        self.mesh, P(self.axis, None))
-                    padded = jax.jit(
-                        functools.partial(_pad_cols, f_pad=f_pad),
-                        out_shardings=pad_sharding)(bins)
-                    if len(self._global_arrays) >= 64:
-                        self._global_arrays.pop(
-                            next(iter(self._global_arrays)))
-                    self._global_arrays[id(bins)] = (bins, padded)
-                bins = padded
+                bins = pad_global(bins, P(self.axis, None),
+                                  functools.partial(_pad_cols, f_pad=f_pad))
+                if binsT is not None:
+                    binsT = pad_global(
+                        binsT, colT,
+                        lambda b: jnp.pad(b, ((0, f_pad), (0, 0))))
+                if bundle_meta is not None:
+                    # inert padded columns, like the replicated path below:
+                    # the grower slices bundle rows by the PADDED feature
+                    # offset, so misaligned rows would corrupt real columns
+                    b = bundle_meta.seg_lo.shape[1]
+                    bundle_meta = type(bundle_meta)(
+                        seg_lo=jnp.pad(bundle_meta.seg_lo,
+                                       ((0, f_pad), (0, 0))),
+                        seg_hi=jnp.pad(bundle_meta.seg_hi,
+                                       ((0, f_pad), (0, 0)),
+                                       constant_values=b - 1),
+                        is_bundle=jnp.pad(bundle_meta.is_bundle, (0, f_pad)),
+                        fwd_ok=jnp.pad(bundle_meta.fwd_ok,
+                                       ((0, f_pad), (0, 0))),
+                        rev_ok=jnp.pad(bundle_meta.rev_ok,
+                                       ((0, f_pad), (0, 0))))
+            extras = {}
+            extras_spec = {}
+            if binsT is not None:
+                # already a GLOBAL feature-major array from load_partitioned
+                extras["binsT"] = binsT
+                extras_spec["binsT"] = colT
+            if bundle_meta is not None:
+                extras["bundle"] = type(bundle_meta)(
+                    *(glob_rep(a, key=ka)
+                      for a, ka in zip(bundle_meta, bundle_meta)))
+                extras_spec["bundle"] = type(bundle_meta)(
+                    *(P() for _ in bundle_meta))
+            if forced_splits is not None:
+                extras["forced"] = tuple(
+                    glob_rep(a, key=ka)
+                    for a, ka in zip(forced_splits, forced_splits))
+                extras_spec["forced"] = tuple(P() for _ in forced_splits)
             if rng_key is None:
                 rng_key = jax.random.PRNGKey(0)
-            key = ("prepart", tuple(sorted(grow_kwargs.items())))
+            key = ("prepart", frozenset(extras),
+                   tuple(sorted(grow_kwargs.items())))
             shard = self._cache.get(key)
             if shard is None:
-                shard = self._build({}, tuple(sorted(grow_kwargs.items())))
+                shard = self._build(extras_spec,
+                                    tuple(sorted(grow_kwargs.items())),
+                                    pre_part=True)
                 self._cache[key] = shard
             tree, leaf_id, aux = shard(bins, grad, hess, sample_mask, meta,
                                        params, feature_mask, missing_bin,
-                                       {}, rng_key)
+                                       extras, rng_key)
             return tree, leaf_id, aux
         # pre-padding originals key the multi-process globalization cache
         # (padding allocates fresh arrays every call)
