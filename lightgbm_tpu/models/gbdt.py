@@ -593,7 +593,8 @@ class GBDT:
             max_depth=cfg.max_depth, hist_method=hm,
             tile_leaves=cfg.tile_leaves,
             hist_block=cfg.hist_block,
-            binsT=ts.bins_T if hm.startswith(("onehot", "pallas")) else None,
+            feature_block=self._feature_block(hm),
+            binsT=ts.bins_T if self._use_binsT(hm) else None,
             sub_idx=sub[0] if sub else None,
             sub_bins=sub[1] if sub else None,
             sub_binsT=sub[2] if sub else None,
@@ -616,6 +617,83 @@ class GBDT:
             bundle_meta=ts.bundle_meta,
             forced_splits=self._forced_splits,
             hist_dp=self._hist_dp)
+
+    def _use_binsT(self, hm: str) -> bool:
+        """The feature-major bins copy doubles the dominant array; above
+        ~2 GiB keep only the row-major matrix (pallas kernels then fall
+        back to the XLA onehot formulation, with routing slicing rows)."""
+        if not hm.startswith(("onehot", "pallas")):
+            return False
+        ts = self.train_set
+        itemsize = 4 if ts.max_num_bins > 256 else 1   # int32 vs uint8 bins
+        bins_bytes = (int(ts.num_data) * int(ts.num_used_features())
+                      * itemsize)
+        if bins_bytes <= 2 << 30:
+            return True
+        if not getattr(self, "_warned_binst", False):
+            self._warned_binst = True
+            log.warning(
+                f"bins matrix is {bins_bytes / 2**30:.1f} GiB: skipping the "
+                "feature-major copy (binsT) to halve memory; pallas "
+                "histogram kernels fall back to the XLA path")
+        return False
+
+    def _feature_block(self, hm: str) -> int:
+        """Column-block width for the grower's memory-bounded mode, or 0
+        to keep the resident [L, F, B, 3] histogram state.
+
+        Engages when that state would exceed ``histogram_pool_size``
+        (the reference's pool cap, config.h histogram_pool_size in MB;
+        <= 0 here means a 2 GiB auto cap rather than unlimited — wide
+        datasets would otherwise OOM the chip). The analog of the
+        reference's HistogramPool LRU (feature_histogram.hpp:1095-1290):
+        over-cap leaves pay recomputation instead of residency."""
+        cfg = self.config
+        ts = self.train_set
+        f_cols = ts.num_used_features()
+        B = ts.max_num_bins
+        hist_bytes = cfg.num_leaves * f_cols * B * 3 * 4
+        pool = cfg.histogram_pool_size
+        cap = int(pool * 1024 * 1024) if pool and pool > 0 else 2 << 30
+        if hist_bytes <= cap:
+            return 0
+        subset_possible = (cfg.bagging_freq > 0
+                           and cfg.bagging_fraction <= 0.5
+                           and cfg.pos_bagging_fraction >= 1.0
+                           and cfg.neg_bagging_fraction >= 1.0
+                           and self._cegb_mode == "off"
+                           and not cfg.linear_tree)
+        unsupported = (self._cegb_mode != "off"
+                       or self._forced_splits is not None
+                       or (self._with_monotone
+                           and self._mono_mode != "basic")
+                       or subset_possible or self._hist_dp
+                       or hm.endswith("_q8"))
+        if unsupported:
+            if not getattr(self, "_warned_pool", False):
+                self._warned_pool = True
+                log.warning(
+                    f"histogram state ({hist_bytes / 2**20:.0f} MB) exceeds "
+                    f"the pool cap ({cap / 2**20:.0f} MB) but the "
+                    "memory-bounded mode does not support "
+                    "CEGB/forced-splits/box-monotone/subset-bagging/f64/q8 "
+                    "here; keeping the resident state (may OOM)")
+            return 0
+        tile = cfg.tile_leaves or 42
+        P = (min(tile, cfg.num_leaves)
+             if hm.startswith(("onehot", "pallas")) else cfg.num_leaves)
+        # transient per feature column: the [P, B, 3] tile plus ~8
+        # search-sized temporaries
+        per_f = P * B * 4 * (3 + 8)
+        fb = max(16, min(f_cols, cap // per_f))
+        if not getattr(self, "_warned_pool", False):
+            self._warned_pool = True
+            log.warning(
+                f"histogram state ({hist_bytes / 2**20:.0f} MB) exceeds the "
+                f"pool cap ({cap / 2**20:.0f} MB): memory-bounded growth "
+                f"engaged ({fb} feature columns per pass, no histogram "
+                "subtraction — ~2x the histogram passes)")
+        return fb
 
     def _localize_leaf_id(self, leaf_id: jax.Array) -> jax.Array:
         """Pre-partitioned mode: slice this process's rows out of the

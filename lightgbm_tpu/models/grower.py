@@ -428,7 +428,7 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
                      "mono_mode", "mono_features",
                      "with_interactions", "cegb_mode", "extra_trees",
                      "use_bynode", "tile_leaves", "hist_block",
-                     "hist_subtraction",
+                     "hist_subtraction", "feature_block",
                      "feature_axis_name", "feature_shards", "voting",
                      "vote_top_k", "hist_dp"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
@@ -459,6 +459,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               tile_leaves: int = 0,
               hist_block: int = 0,
               hist_subtraction: bool = True,
+              feature_block: int = 0,
               feature_axis_name: str | None = None,
               feature_shards: int = 1,
               voting: bool = False,
@@ -504,6 +505,16 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         serial_tree_learner.cpp:311-320). Subtraction is exact for the count
         channel and float32-rounded for grad/hess (the reference subtracts in
         float64; its GPU path is float32 like ours).
+      feature_block: > 0 engages the MEMORY-BOUNDED mode for wide datasets:
+        no [L, F, B, 3] histogram state is kept at all — each pending leaf
+        is histogrammed and searched immediately, ``feature_block`` columns
+        at a time into a transient [P, Fb, B, 3] buffer, and only its best
+        SplitInfo is retained (the analog of the reference's capped
+        HistogramPool, feature_histogram.hpp:1095-1290: a full pool miss
+        for every leaf). Costs ~2x the histogram passes (no parent
+        subtraction) in exchange for O(P * Fb * B) transient memory.
+        Serial learner only; CEGB, forced splits, box-mode monotone
+        constraints, voting and the bagging subset copy are unsupported.
       feature_axis_name: feature-ownership mesh axis. Set WITHOUT axis_name
         (rows replicated) = the feature-parallel learner (reference:
         feature_parallel_tree_learner.cpp:59-78): each device histograms and
@@ -655,15 +666,48 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     # leaves_to_update set after every split, monotone_constraints.hpp:565)
     exact = exact or mono_intermediate
 
+    blocked = feature_block > 0
+    if blocked:
+        assert not fp_mode and not voting and axis_name is None, (
+            "feature-blocked mode is serial-only")
+        assert not cegb_on and forced_splits is None, (
+            "feature-blocked mode does not support CEGB or forced splits")
+        assert not mono_intermediate, (
+            "feature-blocked mode supports only basic monotone constraints")
+        assert not use_subset and not hist_dp and not quant8, (
+            "feature-blocked mode: bagging subset copy / f64 / q8 "
+            "histograms unsupported")
+        hist_subtraction = False    # no resident parent histograms
+
+    def _zero_best_direct() -> SplitInfo:
+        """All -inf placeholder without materializing a [L, F, B, 3] zeros
+        histogram (which is exactly what blocked mode must avoid)."""
+        zi = jnp.zeros((L,), jnp.int32)
+        zf32 = jnp.zeros((L,), jnp.float32)
+        return SplitInfo(
+            gain=jnp.full((L,), NEG_INF, jnp.float32),
+            feature=zi, threshold=zi,
+            default_left=jnp.zeros((L,), bool),
+            left_sum_g=zf32, left_sum_h=zf32, left_count=zf32,
+            right_sum_g=zf32, right_sum_h=zf32, right_count=zf32,
+            left_output=zf32, right_output=zf32,
+            is_cat=jnp.zeros((L,), bool),
+            cat_bitset=jnp.zeros((L, cat_words), jnp.uint32),
+            seg_lo=jnp.full((L,), -1, jnp.int32),
+            seg_hi=jnp.full((L,), -1, jnp.int32))
+
     def init_state() -> GrowState:
         zf = functools.partial(jnp.zeros, dtype=hist_dtype)
-        zero_best = find_best_splits(  # shape-consistent placeholder (all -inf)
-            zf((L, f_loc, num_bins, 3)),
-            zf((L,)), zf((L,)), zf((L,)), zf((L,)),
-            jnp.zeros((L,), jnp.int32), meta_s, params,
-            jnp.zeros((f_loc,), jnp.float32),
-            max_depth, with_categorical=False, cat_words=cat_words,
-            bundle=bundle_s)
+        if blocked:
+            zero_best = _zero_best_direct()
+        else:
+            zero_best = find_best_splits(  # shape-consistent placeholder
+                zf((L, f_loc, num_bins, 3)),
+                zf((L,)), zf((L,)), zf((L,)), zf((L,)),
+                jnp.zeros((L,), jnp.int32), meta_s, params,
+                jnp.zeros((f_loc,), jnp.float32),
+                max_depth, with_categorical=False, cat_words=cat_words,
+                bundle=bundle_s)
         if cegb_state is not None:
             used_split = cegb_state.used_split
             row_used = cegb_state.row_used
@@ -674,7 +718,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             leaf_id=jnp.zeros((n,), jnp.int32),
             leaf_id_sub=jnp.zeros((sub_idx.shape[0],) if use_subset else (1,),
                                   jnp.int32),
-            hist=jnp.zeros((L, f_loc, num_bins, 3), hist_dtype),
+            hist=jnp.zeros((1, 1, 1, 1) if blocked
+                           else (L, f_loc, num_bins, 3), hist_dtype),
             hist_valid=jnp.zeros((L,), bool),
             leaf_dead=jnp.zeros((L,), bool),
             leaf_sum_g=zf((L,)).at[0].set(root[0]),
@@ -1069,6 +1114,115 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         return state._replace(forced_idx=k_idx + 1, forced_slot=slot,
                               done=jnp.bool_(False))
 
+    def merge_best(a: SplitInfo, b: SplitInfo) -> SplitInfo:
+        """Cross-block best merge: strictly greater gain replaces, ties keep
+        the earlier block = the lower feature index (the reference's
+        cross-feature tie rule, serial_tree_learner.cpp:374-448)."""
+        take = b.gain > a.gain
+
+        def w(x, y):
+            m = take if x.ndim == 1 else take[:, None]
+            return jnp.where(m, y, x)
+
+        return SplitInfo(*(w(x, y) for x, y in zip(a, b)))
+
+    def blocked_pass(state: GrowState) -> GrowState:
+        """Histogram + search for a tile of pending leaves, one feature
+        block at a time; only the winning SplitInfo survives the block."""
+        pending = pending_mask(state)
+        order = jnp.argsort(jnp.where(pending, iota_l, L + iota_l))
+        chosen = order[:P].astype(jnp.int32)
+        chosen_ok = pending[chosen]
+        sel = jnp.where(chosen_ok, chosen, -1)
+
+        round_key = jax.random.fold_in(rng_key, state.rounds)
+        fmask_sel = leaf_feature_mask(state, round_key)[chosen] \
+            .astype(jnp.float32)                              # [P, f]
+        rand_bin_sel = None
+        if extra_trees:
+            nbm = jnp.maximum(meta.num_bins - 2, 1)
+            u = jax.random.uniform(jax.random.fold_in(round_key, 2), (L, f))
+            rand_bin_sel = (u * nbm[None, :]).astype(jnp.int32)[chosen]
+
+        sum_g = state.leaf_sum_g[chosen]
+        sum_h = state.leaf_sum_h[chosen]
+        cnt = state.leaf_cnt[chosen]
+        outp = state.leaf_output[chosen]
+        depth = state.leaf_depth[chosen]
+        lmin = state.leaf_min[chosen] if with_monotone else None
+        lmax = state.leaf_max[chosen] if with_monotone else None
+
+        best_t = None
+        for bi in range(-(-f // feature_block)):
+            s_, e_ = bi * feature_block, min((bi + 1) * feature_block, f)
+            tile = histogram_tiles(
+                bins[:, s_:e_], stats, state.leaf_id, sel, num_bins,
+                method=hist_method, dtype=hist_dtype,
+                binsT=binsT[s_:e_] if binsT is not None else None,
+                block=hist_block)
+            mb = FeatureMeta(*(a[s_:e_] for a in meta))
+            bundle_b = (type(bundle_meta)(*(a[s_:e_] for a in bundle_meta))
+                        if bundle_meta is not None else None)
+            bb = find_best_splits(
+                tile, sum_g, sum_h, cnt, outp, depth, mb, params,
+                fmask_sel[:, s_:e_], max_depth,
+                with_categorical=with_categorical, cat_words=cat_words,
+                leaf_min=lmin, leaf_max=lmax,
+                rand_bin=(rand_bin_sel[:, s_:e_]
+                          if rand_bin_sel is not None else None),
+                bundle=bundle_b)
+            bb = bb._replace(feature=bb.feature + s_)
+            best_t = bb if best_t is None else merge_best(best_t, bb)
+
+        def scat(cur, new):
+            m = chosen_ok if new.ndim == 1 else chosen_ok[:, None]
+            return cur.at[chosen].set(jnp.where(m, new, cur[chosen]))
+
+        new_best = SplitInfo(*(scat(c, nb)
+                               for c, nb in zip(state.best, best_t)))
+        return state._replace(
+            best=new_best,
+            hist_valid=state.hist_valid.at[chosen].set(
+                state.hist_valid[chosen] | chosen_ok),
+            rounds=state.rounds + 1)
+
+    def split_phase_blocked(state: GrowState) -> GrowState:
+        """Apply splits from the STORED per-leaf bests (no re-search — the
+        histograms are gone). Valid because a leaf's best is invariant
+        until it is split: basic-monotone bounds and interaction masks
+        only change for the split leaf's children, which are re-searched
+        with fresh histograms anyway."""
+        num_leaves_before = state.num_leaves
+        state = state._replace(rounds=state.rounds + 1)
+        gain_eff = jnp.where(active_mask(state) & state.hist_valid
+                             & ~state.leaf_dead, state.best.gain, NEG_INF)
+        apply_kw = dict(with_monotone=with_monotone,
+                        with_interactions=with_interactions,
+                        cegb_lazy=False, mono_intermediate=False,
+                        sub_bins=None, sub_binsT=None)
+        if exact:
+            def do_split(carry):
+                st, ge = carry
+                return _apply_split(st, bins, binsT, missing_bin, ge, meta,
+                                    **apply_kw)
+
+            state, _ = jax.lax.cond(
+                (state.num_leaves < L) & (jnp.max(gain_eff) > 0.0),
+                do_split, lambda c: c, (state, gain_eff))
+        else:
+            def inner_cond(carry):
+                st, ge = carry
+                return (st.num_leaves < L) & (jnp.max(ge) > 0.0)
+
+            def inner_body(carry):
+                st, ge = carry
+                return _apply_split(st, bins, binsT, missing_bin, ge, meta,
+                                    **apply_kw)
+
+            state, _ = jax.lax.while_loop(inner_cond, inner_body,
+                                          (state, gain_eff))
+        return state._replace(done=state.num_leaves == num_leaves_before)
+
     def outer_body(state: GrowState) -> GrowState:
         # BeforeFindBestSplit guards (serial_tree_learner.cpp:282-322): a
         # leaf failing the 2x min-data/min-hessian check is never
@@ -1078,6 +1232,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                  & (state.leaf_sum_h >= 2.0 * params.min_sum_hessian_in_leaf))
         newly_dead = active & ~state.hist_valid & ~state.leaf_dead & ~guard
         state = state._replace(leaf_dead=state.leaf_dead | newly_dead)
+        if blocked:
+            return jax.lax.cond(jnp.any(pending_mask(state)),
+                                blocked_pass, split_phase_blocked, state)
         if forced_splits is not None:
             k_total = forced_splits[0].shape[0]
 

@@ -1,0 +1,147 @@
+"""Memory-bounded growth for wide datasets (the analog of the reference's
+capped HistogramPool, feature_histogram.hpp:1095-1290): when the resident
+[L, F, B, 3] histogram state would exceed histogram_pool_size, the grower
+switches to feature-blocked passes that keep only per-leaf SplitInfo."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _wide_problem(n=2500, f=96, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.7 * X[:, 3] - 0.5 * X[:, 10]
+         + 0.1 * rng.normal(size=n))
+    return X, y
+
+
+def test_blocked_mode_matches_resident():
+    """A tiny histogram_pool_size forces the blocked mode; the trained
+    model must closely match the default resident-state model (the
+    resident run keeps histogram subtraction, whose f32 rounding differs,
+    so the assertion is allclose — exact grower-level parity with
+    subtraction disabled is test_blocked_grower_bit_parity)."""
+    X, y = _wide_problem()
+    base = {"objective": "regression", "num_leaves": 31,
+            "min_data_in_leaf": 20, "verbosity": -1,
+            "histogram_method": "scatter"}
+    b_res = lgb.train(base, lgb.Dataset(X, label=y), 5)
+    b_blk = lgb.train({**base, "histogram_pool_size": 0.05},
+                      lgb.Dataset(X, label=y), 5)
+    # the blocked mode disables histogram subtraction, whose f32 rounding
+    # the resident mode's larger-sibling derivation carries — predictions
+    # agree tightly but not bitwise
+    np.testing.assert_allclose(b_blk.predict(X), b_res.predict(X),
+                               rtol=1e-4, atol=1e-5)
+    r2 = 1 - np.mean((b_blk.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.5, r2
+
+
+def test_blocked_mode_engagement_decision():
+    X, y = _wide_problem(n=500, f=32)
+
+    def block_of(extra):
+        b = lgb.train({"objective": "regression", "num_leaves": 31,
+                       "verbosity": -1, **extra},
+                      lgb.Dataset(X, label=y, params={"verbosity": -1}), 1)
+        return b._boosting._feature_block("scatter")
+
+    # default cap (2 GiB) leaves narrow data resident
+    assert block_of({}) == 0
+    # a tiny pool engages blocking with a bounded column width
+    fb = block_of({"histogram_pool_size": 0.05})
+    assert 0 < fb <= 32, fb
+
+
+def test_blocked_mode_wide_smoke():
+    """A genuinely wide dataset (512 used features, 255 leaves) trains
+    through the blocked path: the resident state would be
+    255*512*256*3*4 = 382 MB against a 16 MB pool."""
+    X, y = _wide_problem(n=1500, f=512, seed=3)
+    params = {"objective": "regression", "num_leaves": 255,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "histogram_pool_size": 16,
+              "histogram_method": "scatter"}
+    b = lgb.train(params, lgb.Dataset(X, label=y), 3)
+    p = b.predict(X)
+    r2 = 1 - np.mean((p - y) ** 2) / np.var(y)
+    assert r2 > 0.4, r2   # 3 informative of 512 features, 3 rounds
+
+
+def test_blocked_mode_with_bagging_and_monotone():
+    """Mask bagging and basic monotone constraints ride the blocked path."""
+    X, y = _wide_problem(n=2000, f=64, seed=5)
+    mono = [0] * 64
+    mono[0] = 1
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 20, "verbosity": -1,
+              "histogram_pool_size": 0.05,
+              "bagging_freq": 1, "bagging_fraction": 0.8,
+              "monotone_constraints": mono,
+              "histogram_method": "scatter"}
+    b = lgb.train(params, lgb.Dataset(X, label=y), 8)
+    # monotonicity on feature 0
+    rng = np.random.RandomState(0)
+    pts = rng.normal(size=(30, 64)).astype(np.float32)
+    grid = np.linspace(-2, 2, 20)
+    preds = []
+    for g in grid:
+        Xg = pts.copy()
+        Xg[:, 0] = g
+        preds.append(b.predict(Xg))
+    assert (np.diff(np.asarray(preds), axis=0) >= -1e-10).all()
+
+
+def test_blocked_mode_unsupported_combo_falls_back():
+    """CEGB forces the resident state (with a warning), not a crash."""
+    X, y = _wide_problem(n=400, f=32)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 20, "verbosity": -1,
+              "histogram_pool_size": 0.01,
+              "cegb_tradeoff": 1.0, "cegb_penalty_split": 0.1,
+              "histogram_method": "scatter"}
+    b = lgb.train(params, lgb.Dataset(X, label=y), 2)
+    assert b._boosting._feature_block("scatter") == 0
+
+
+def test_blocked_grower_bit_parity():
+    """grow_tree with feature_block set produces the IDENTICAL tree to the
+    resident grower with subtraction disabled, at several block widths
+    (including one block covering all features and a non-divisor width)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.models.grower import grow_tree
+    from lightgbm_tpu.ops.split import FeatureMeta, SplitParams
+
+    rng = np.random.RandomState(7)
+    n, f, b = 2000, 50, 32
+    bins = rng.randint(0, b, size=(n, f)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    meta = FeatureMeta(
+        num_bins=jnp.full((f,), b, jnp.int32),
+        missing_type=jnp.zeros((f,), jnp.int32),
+        default_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool),
+        monotone=jnp.zeros((f,), jnp.int8),
+        penalty=jnp.ones((f,), jnp.float32))
+    params = SplitParams.from_config(
+        lgb.Config.from_params({"min_data_in_leaf": 5}))
+    common = dict(max_leaves=31, num_bins=b, hist_method="scatter")
+    mask = np.ones((n,), np.float32)
+    fmask = np.ones((f,), np.float32)
+    mb = np.full((f,), -1, np.int32)
+    t_res, _, _ = grow_tree(bins, grad, hess, mask, meta, params, fmask, mb,
+                            hist_subtraction=False, **common)
+    for fb in (16, 23, 64):
+        t_blk, _, _ = grow_tree(bins, grad, hess, mask, meta, params, fmask,
+                                mb, feature_block=fb, **common)
+        assert int(t_blk.num_leaves) == int(t_res.num_leaves)
+        np.testing.assert_array_equal(np.asarray(t_blk.node_feature),
+                                      np.asarray(t_res.node_feature))
+        np.testing.assert_array_equal(
+            np.asarray(t_blk.node_threshold_bin),
+            np.asarray(t_res.node_threshold_bin))
+        np.testing.assert_allclose(np.asarray(t_blk.leaf_value),
+                                   np.asarray(t_res.leaf_value), rtol=1e-6)
