@@ -22,6 +22,10 @@ import sys
 import time
 import traceback
 
+# per-phase timer table (the reference's USE_TIMETAG analog) — enabled
+# before the library imports so every run prints the breakdown
+os.environ.setdefault("LIGHTGBM_TPU_TIMETAG", "1")
+
 BASELINE_SEC_PER_ITER = 130.094 / 500  # docs/Experiments.rst:108-124
 FULL_ROWS = 10_500_000
 # v5e peak: ~197 TFLOP/s bf16 / ~98 f32 (MFU denominator assumption)
@@ -188,6 +192,12 @@ def main():
                           "error": "all ladder scales failed"}))
         sys.exit(1)
 
+    from lightgbm_tpu.utils import profiling
+    print("# ---- phase timer table (LIGHTGBM_TPU_TIMETAG) ----",
+          file=sys.stderr)
+    for line in profiling.table().splitlines():
+        print(f"# {line}", file=sys.stderr)
+
     # secondary probe: the opt-in int8 quantized-gradient mode (timing
     # only, short run — the headline number stays on the default path)
     q8_sec = None
@@ -201,6 +211,26 @@ def main():
         except Exception:
             traceback.print_exc(file=sys.stderr)
             print("# q8 probe failed; omitting", file=sys.stderr)
+
+    # max_bin=63: the reference's RECOMMENDED GPU configuration with
+    # published AUC parity (docs/GPU-Performance.rst:43-47: CPU-255
+    # 0.845612 vs GPU-63 0.845209 on Higgs) — ~4x fewer one-hot MACs per
+    # histogram pass. Timed at the same scale with its own AUC readout so
+    # speed-at-matched-quality is on the record.
+    b63_sec = b63_auc = None
+    if (used_method == "auto" and jax.default_backend() == "tpu"
+            and args.max_bin != 63):
+        try:
+            b63_args = argparse.Namespace(**{**vars(args), "max_bin": 63})
+            b63_sec, b63_ph, b63_auc, _ = run_at_scale(
+                used_rows, b63_args, hist_method="auto")
+            print(f"# max_bin=63: {b63_sec:.3f} s/iter, "
+                  f"auc={b63_auc}", file=sys.stderr)
+            for kk, vv in b63_ph.items():
+                print(f"# b63 phase {kk}: {vv:.3f}s", file=sys.stderr)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            print("# max_bin=63 probe failed; omitting", file=sys.stderr)
 
     for k, v in phases.items():
         print(f"# phase {k}: {v:.3f}s", file=sys.stderr)
@@ -230,6 +260,9 @@ def main():
         "auc_rounds": rounds_run,
         "hist_method": used_method,
         "q8_sec_per_iter": round(q8_sec, 4) if q8_sec is not None else None,
+        "bin63_sec_per_iter": round(b63_sec, 4) if b63_sec is not None
+        else None,
+        "bin63_auc": round(b63_auc, 6) if b63_auc is not None else None,
         "phases": {k: round(v, 3) for k, v in phases.items()},
     }))
 

@@ -626,8 +626,11 @@ class GBDT:
             return False
         ts = self.train_set
         itemsize = 4 if ts.max_num_bins > 256 else 1   # int32 vs uint8 bins
-        bins_bytes = (int(ts.num_data) * int(ts.num_used_features())
-                      * itemsize)
+        # per-HOST bytes: pre-partitioned data is row-sharded, so the copy
+        # costs each host only its shard
+        rows = (ts.num_local_data if getattr(self, "_pre_part", False)
+                else ts.num_data)
+        bins_bytes = int(rows) * int(ts.num_used_features()) * itemsize
         if bins_bytes <= 2 << 30:
             return True
         if not getattr(self, "_warned_binst", False):
@@ -709,9 +712,29 @@ class GBDT:
         return jnp.asarray(local[:n_local])
 
     def _hist_method(self) -> str:
-        from ..ops.histogram import resolve_method
-        return resolve_method(self.config.histogram_method,
-                              deterministic=self.config.deterministic)
+        from ..ops.histogram import measured_auto_method, resolve_method
+        cfg = self.config
+        if (cfg.histogram_method == "auto" and not cfg.deterministic
+                and jax.default_backend() == "tpu"
+                and self.train_set is not None
+                and jax.process_count() == 1):
+            # single-process only: per-host wall-clock winners could
+            # diverge and the method is a static jit arg — multi-process
+            # SPMD programs must match, so those keep the structural choice
+            # measured choice (TestMultiThreadingMethod analog): timed once
+            # per shape at first use, cached on the booster thereafter
+            hit = getattr(self, "_measured_hm", None)
+            if hit is None:
+                ts = self.train_set
+                binsT = ts.bins_T if self._use_binsT("pallas") else None
+                hit = measured_auto_method(
+                    ts.bins, binsT, ts.max_num_bins,
+                    tile_leaves=cfg.tile_leaves or 42,
+                    hist_block=cfg.hist_block)
+                self._measured_hm = hit
+            return hit
+        return resolve_method(cfg.histogram_method,
+                              deterministic=cfg.deterministic)
 
     def _sample_weights(self, g, h) -> Optional[jax.Array]:
         """Hook for GOSS-style reweighted sampling; None = use bag mask."""

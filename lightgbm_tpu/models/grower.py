@@ -1010,35 +1010,12 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         gain_eff = jnp.where(active_mask(state) & state.hist_valid
                              & ~state.leaf_dead, best.gain, NEG_INF)
-
-        apply_kw = dict(with_monotone=with_monotone,
-                        with_interactions=with_interactions,
-                        cegb_lazy=cegb_lazy,
-                        mono_intermediate=mono_intermediate,
-                        sub_bins=sub_bins, sub_binsT=sub_binsT)
-
-        if exact:
-            # strict best-first: one split per phase, then recompute children
-            def do_split(carry):
-                st, ge = carry
-                return _apply_split(st, bins, binsT, missing_bin, ge, meta,
-                                    **apply_kw)
-
-            state, _ = jax.lax.cond(
-                (state.num_leaves < L) & (jnp.max(gain_eff) > 0.0),
-                do_split, lambda c: c, (state, gain_eff))
-        else:
-            def inner_cond(carry):
-                st, ge = carry
-                return (st.num_leaves < L) & (jnp.max(ge) > 0.0)
-
-            def inner_body(carry):
-                st, ge = carry
-                return _apply_split(st, bins, binsT, missing_bin, ge, meta,
-                                    **apply_kw)
-
-            state, _ = jax.lax.while_loop(inner_cond, inner_body,
-                                          (state, gain_eff))
+        state = apply_splits(state, gain_eff, dict(
+            with_monotone=with_monotone,
+            with_interactions=with_interactions,
+            cegb_lazy=cegb_lazy,
+            mono_intermediate=mono_intermediate,
+            sub_bins=sub_bins, sub_binsT=sub_binsT))
         return state._replace(done=state.num_leaves == num_leaves_before)
 
     def forced_phase(state: GrowState) -> GrowState:
@@ -1186,20 +1163,11 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 state.hist_valid[chosen] | chosen_ok),
             rounds=state.rounds + 1)
 
-    def split_phase_blocked(state: GrowState) -> GrowState:
-        """Apply splits from the STORED per-leaf bests (no re-search — the
-        histograms are gone). Valid because a leaf's best is invariant
-        until it is split: basic-monotone bounds and interaction masks
-        only change for the split leaf's children, which are re-searched
-        with fresh histograms anyway."""
-        num_leaves_before = state.num_leaves
-        state = state._replace(rounds=state.rounds + 1)
-        gain_eff = jnp.where(active_mask(state) & state.hist_valid
-                             & ~state.leaf_dead, state.best.gain, NEG_INF)
-        apply_kw = dict(with_monotone=with_monotone,
-                        with_interactions=with_interactions,
-                        cegb_lazy=False, mono_intermediate=False,
-                        sub_bins=None, sub_binsT=None)
+    def apply_splits(state: GrowState, gain_eff: jax.Array,
+                     apply_kw: dict) -> GrowState:
+        """Shared split-application loop: strict best-first (one split per
+        phase) under ``exact``, otherwise every positive-gain split this
+        round via an inner while_loop."""
         if exact:
             def do_split(carry):
                 st, ge = carry
@@ -1221,6 +1189,23 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
             state, _ = jax.lax.while_loop(inner_cond, inner_body,
                                           (state, gain_eff))
+        return state
+
+    def split_phase_blocked(state: GrowState) -> GrowState:
+        """Apply splits from the STORED per-leaf bests (no re-search — the
+        histograms are gone). Valid because a leaf's best is invariant
+        until it is split: basic-monotone bounds and interaction masks
+        only change for the split leaf's children, which are re-searched
+        with fresh histograms anyway."""
+        num_leaves_before = state.num_leaves
+        state = state._replace(rounds=state.rounds + 1)
+        gain_eff = jnp.where(active_mask(state) & state.hist_valid
+                             & ~state.leaf_dead, state.best.gain, NEG_INF)
+        state = apply_splits(state, gain_eff, dict(
+            with_monotone=with_monotone,
+            with_interactions=with_interactions,
+            cegb_lazy=False, mono_intermediate=False,
+            sub_bins=None, sub_binsT=None))
         return state._replace(done=state.num_leaves == num_leaves_before)
 
     def outer_body(state: GrowState) -> GrowState:

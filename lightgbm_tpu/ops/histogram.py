@@ -147,6 +147,71 @@ def resolve_method(method: str, deterministic: bool = False) -> str:
     return method
 
 
+# measured auto-selection cache: (F, B, log2-rows-bucket, has_binsT) -> method
+_measured_method: dict = {}
+
+
+def measured_auto_method(bins, binsT, num_bins: int, tile_leaves: int = 42,
+                         hist_block: int = 0, sample_rows: int = 262144,
+                         force_measure: bool = False) -> str:
+    """TIME the candidate histogram backends on a sampled row block and
+    return the fastest — the analog of the reference's col-wise/row-wise
+    auto benchmark (dataset.cpp:591-689 TestMultiThreadingMethod), which
+    measures rather than guesses because the ranking is shape-dependent.
+
+    Candidates are the two production TPU formulations of the same
+    contraction, ``pallas_hilo`` (fused VMEM kernel) and ``onehot_hilo``
+    (XLA one-hot matmul); quantized/HIGHEST modes change numerics and are
+    never auto-chosen. The winner is cached per (features, bins,
+    log2-row bucket, binsT availability) so repeated Boosters on similar
+    shapes skip the probe. Non-TPU backends return "scatter" without
+    measuring (structurally fastest there); ``force_measure`` overrides
+    for tests.
+    """
+    import time
+
+    if jax.default_backend() != "tpu" and not force_measure:
+        return "scatter"
+    n, f = bins.shape
+    key = (f, int(num_bins), max(n, 1).bit_length(), binsT is not None)
+    hit = _measured_method.get(key)
+    if hit is not None:
+        return hit
+    k = min(n, sample_rows)
+    sub = bins[:k]
+    subT = binsT[:, :k] if binsT is not None else None
+    stats = jnp.ones((k, 3), jnp.float32)
+    lid = jnp.zeros((k,), jnp.int32)
+    p = max(1, min(tile_leaves, 42))
+    sel = jnp.zeros((p,), jnp.int32).at[1:].set(-1)
+    candidates = ["onehot_hilo"]
+    if subT is not None:
+        candidates.insert(0, "pallas_hilo")
+    times = {}
+    for m in candidates:
+        fn = jax.jit(functools.partial(
+            histogram_tiles, num_bins=num_bins, method=m,
+            block=hist_block))
+        try:
+            r = fn(sub, stats, lid, sel, binsT=subT)
+            float(jnp.sum(r))                  # compile + first run
+            t0 = time.time()
+            r = fn(sub, stats, lid, sel, binsT=subT)
+            float(jnp.sum(r))                  # sync via scalar fetch
+            times[m] = time.time() - t0
+        except Exception:                      # kernel unsupported here
+            continue
+    if not times:
+        return "onehot_hilo"
+    winner = min(times, key=times.get)
+    from ..utils import log
+    log.info("histogram auto-selection: "
+             + ", ".join(f"{m}={t * 1e3:.1f}ms" for m, t in times.items())
+             + f" -> {winner} (at {k} sampled rows)")
+    _measured_method[key] = winner
+    return winner
+
+
 def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
                     sel: jax.Array, num_bins: int, method: str = "onehot",
                     block: int = 0, dtype=jnp.float32,

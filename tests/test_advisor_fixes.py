@@ -242,3 +242,23 @@ def test_sparse_predict_with_loaded_init_model():
                    init_model=lgb.Booster(model_str=b1.model_to_string()))
     np.testing.assert_allclose(b2.predict(sp.csr_matrix(X)), b2.predict(X),
                                rtol=1e-6)
+
+
+def test_measured_auto_method_probe():
+    """measured_auto_method times the candidate backends and caches the
+    winner per shape (forced on CPU via force_measure; the pallas kernel
+    degrades to onehot here so both candidates run)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops import histogram as H
+
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, 16, size=(4096, 6)).astype(np.uint8))
+    binsT = jnp.asarray(np.asarray(bins).T)
+    H._measured_method.clear()
+    m = H.measured_auto_method(bins, binsT, 16, force_measure=True)
+    assert m in ("pallas_hilo", "onehot_hilo")
+    assert len(H._measured_method) == 1
+    # cached: second call returns without re-timing (same key)
+    assert H.measured_auto_method(bins, binsT, 16, force_measure=True) == m
+    # CPU backend without force: structural choice, no probe
+    assert H.measured_auto_method(bins, None, 16) == "scatter"
