@@ -225,6 +225,110 @@ def maybe_init_from_config(config) -> None:
         init(num_machines=nm, params=config)
 
 
+def spawn(fn, nproc: int = 2, args: tuple = (),
+          devices_per_proc: Optional[int] = None,
+          timeout: Optional[float] = 600.0):
+    """Run ``fn(rank, *args)`` in ``nproc`` freshly spawned local processes
+    wired into one jax.distributed cluster, and return rank 0's result —
+    the single-host analog of the reference's Dask orchestration
+    (python-package/lightgbm/dask.py:211-330 _train: find open ports,
+    inject machines/num_machines/local_listen_port per worker, run local
+    fits, return the rank-0 model; examples/parallel_learning's mlist
+    flow). Co-location is the caller's: ``fn`` typically slices its rank's
+    rows and calls ``load_partitioned`` + ``train``.
+
+    ``fn`` must be picklable (a module-level function). Each child calls
+    ``distributed.init`` before ``fn`` runs; ``devices_per_proc`` forces a
+    virtual CPU device count (tests), otherwise children inherit the
+    environment. ``timeout`` is the OVERALL deadline for all ranks; a
+    child that dies without reporting fails fast with its exit code.
+    Returns rank 0's return value (must be picklable); raises
+    RuntimeError with the failing rank's traceback on error.
+    """
+    import multiprocessing as mp
+    import queue as _queue
+    import socket as _socket
+    import time as _time
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    machines = ",".join(f"127.0.0.1:{port}" for _ in range(nproc))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(
+        target=_spawn_child,
+        args=(q, fn, r, nproc, machines, devices_per_proc, args))
+        for r in range(nproc)]
+    for p in procs:
+        p.start()
+    results = {}
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    try:
+        while len(results) < nproc:
+            try:
+                rank, ok, payload = q.get(timeout=1.0)
+            except _queue.Empty:
+                # a segfaulted/OOM-killed child never enqueues: fail fast
+                # with the dead rank identified instead of waiting out the
+                # full deadline
+                for r, p in enumerate(procs):
+                    if r not in results and not p.is_alive() \
+                            and p.exitcode not in (0, None):
+                        raise RuntimeError(
+                            f"distributed.spawn rank {r} died with exit "
+                            f"code {p.exitcode} before reporting")
+                if deadline is not None and _time.monotonic() > deadline:
+                    missing = [r for r in range(nproc) if r not in results]
+                    raise RuntimeError(
+                        f"distributed.spawn timed out after {timeout}s "
+                        f"waiting for ranks {missing}")
+                continue
+            if not ok:
+                raise RuntimeError(
+                    f"distributed.spawn rank {rank} failed:\n{payload}")
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+            if p.is_alive():          # SIGTERM swallowed in native code
+                p.kill()
+                p.join(timeout=10)
+    return results.get(0)
+
+
+def prepare_cpu_device_env(env, devices_per_proc: int) -> None:
+    """Force ``devices_per_proc`` virtual CPU devices in an environment
+    mapping (child-process setup shared by ``spawn`` and the test
+    harnesses): pins JAX_PLATFORMS=cpu, clears JAX_NUM_CPU_DEVICES (which
+    would override the XLA flag), and rewrites
+    --xla_force_host_platform_device_count."""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JAX_NUM_CPU_DEVICES", None)
+    flags = [t for t in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in t]
+    flags.append(
+        f"--xla_force_host_platform_device_count={devices_per_proc}")
+    env["XLA_FLAGS"] = " ".join(flags)
+
+
+def _spawn_child(q, fn, rank, nproc, machines, devices_per_proc, args):
+    import traceback
+    try:
+        if devices_per_proc is not None:
+            prepare_cpu_device_env(os.environ, devices_per_proc)
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        init(machines=machines, num_machines=nproc, process_id=rank)
+        q.put((rank, True, fn(rank, *args)))
+    except BaseException:
+        q.put((rank, False, traceback.format_exc()))
+
+
 def allgather_f64(arr):
     """``process_allgather`` that PRESERVES float64 bits by gathering the
     raw bytes: with jax x64 disabled, a plain allgather round-trips
