@@ -247,13 +247,9 @@ def spawn(fn, nproc: int = 2, args: tuple = (),
     """
     import multiprocessing as mp
     import queue as _queue
-    import socket as _socket
     import time as _time
 
-    s = _socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    port = free_port()
     machines = ",".join(f"127.0.0.1:{port}" for _ in range(nproc))
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
@@ -301,6 +297,17 @@ def spawn(fn, nproc: int = 2, args: tuple = (),
     return results.get(0)
 
 
+def free_port() -> int:
+    """Grab an ephemeral localhost port (bind-then-close; shared by
+    ``spawn`` and the multi-host test harness so the idiom lives once)."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def prepare_cpu_device_env(env, devices_per_proc: int) -> None:
     """Force ``devices_per_proc`` virtual CPU devices in an environment
     mapping (child-process setup shared by ``spawn`` and the test
@@ -324,7 +331,13 @@ def _spawn_child(q, fn, rank, nproc, machines, devices_per_proc, args):
             import jax
             jax.config.update("jax_platforms", "cpu")
         init(machines=machines, num_machines=nproc, process_id=rank)
-        q.put((rank, True, fn(rank, *args)))
+        result = fn(rank, *args)
+        # pre-pickle INSIDE the try: Queue.put pickles later, in a feeder
+        # thread, so an unpicklable return value would otherwise vanish
+        # (child exits 0, parent waits out the full deadline)
+        import pickle
+        pickle.dumps(result)
+        q.put((rank, True, result))
     except BaseException:
         q.put((rank, False, traceback.format_exc()))
 

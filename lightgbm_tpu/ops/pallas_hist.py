@@ -48,20 +48,31 @@ def _hist_kernel(binsT_ref, rhs_ref, out_ref, *, f, b, c, mode):
 
     rhs = rhs_ref[...]     # [C, 2*PAD] bf16 | [C, PAD] f32 | [C, PAD] int8
     binsT = binsT_ref[...]                               # [F, C] int8
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (c, b), 1)
     oh_dtype = {"hilo": jnp.bfloat16, "highest": jnp.float32,
                 "q8": jnp.int8}[mode]
     acc_dtype = jnp.int32 if mode == "q8" else jnp.float32
     prec = jax.lax.Precision.HIGHEST if mode == "highest" else None
-    for j in range(f):                                   # static unroll
-        col = binsT[j, :].astype(jnp.int32)              # [C]
-        oh = (col[:, None] == iota_b).astype(oh_dtype)   # [C, B] in VMEM
+    # Feature packing: with b <= 64 bins a single feature's one-hot fills
+    # only b of the MXU's 128 output rows, so the matmul runs at b/128
+    # utilization. Pack g = 128//b features side by side into one
+    # [C, g*b] one-hot (disjoint lane ranges, so a plain sum builds the
+    # OR) — the max_bin=63 configuration then drives full 128-row MXU
+    # tiles instead of half-empty ones.
+    g = max(1, _PAD // b) if b <= _PAD else 1
+    for j0 in range(0, f, g):                            # static unroll
+        m = min(g, f - j0)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (c, m * b), 1)
+        oh = None
+        for k in range(m):
+            col = binsT[j0 + k, :].astype(jnp.int32) + k * b   # [C]
+            hit = (col[:, None] == iota).astype(oh_dtype)      # [C, m*B]
+            oh = hit if oh is None else oh + hit
         acc = jax.lax.dot_general(
             oh, rhs, (((0,), (0,)), ((), ())), precision=prec,
             preferred_element_type=acc_dtype)
         if mode == "hilo":
             acc = acc[:, :_PAD] + acc[:, _PAD:]          # recombine halves
-        out_ref[j * b:(j + 1) * b, :] += acc
+        out_ref[j0 * b:(j0 + m) * b, :] += acc
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "block", "mode"))
@@ -84,7 +95,13 @@ def _hist_pallas_call(binsT, rhs, *, num_bins, block, mode):
         out_specs=pl.BlockSpec((f * num_bins, _PAD), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((f * num_bins, _PAD), out_dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+            dimension_semantics=("arbitrary",),
+            # the default 16M scoped-vmem cap rejects the q8 mode at full
+            # Higgs scale (measured 2026-07-30: int8 accumulation needed a
+            # 28.31M stack allocation at block=2048, F=28, B=255); the
+            # kernel's working set is still far below the 128M physical
+            # VMEM, so raise the cap rather than shrink the block
+            vmem_limit_bytes=100 * 1024 * 1024),
     )(binsT, rhs)
 
 

@@ -6,7 +6,6 @@ in-process analog of the reference's two-machine socket test setup
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
@@ -72,18 +71,10 @@ print("RESULT " + json.dumps(out))
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
 def _run_procs(nproc, devices_per_proc, timeout=420, src=None):
-    from lightgbm_tpu.distributed import prepare_cpu_device_env
+    from lightgbm_tpu.distributed import free_port, prepare_cpu_device_env
     src = _CHILD if src is None else src
-    port = _free_port()
+    port = free_port()
     env = dict(os.environ)
     prepare_cpu_device_env(env, devices_per_proc)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
