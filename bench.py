@@ -37,6 +37,13 @@ def run_at_scale(rows, args, hist_method="auto"):
     import jax
     import lightgbm_tpu as lgb
 
+    def mark(name):
+        # stream phase completions so a wedged tunnel RPC is attributable
+        # to a specific phase in the log (observed 2026-07-31: the axon
+        # relay can stall mid-run with no in-VM recovery)
+        print(f"# [{time.strftime('%H:%M:%S')}] phase done: {name}",
+              file=sys.stderr, flush=True)
+
     phases = {}
     rng = np.random.RandomState(0)
     # train + held-out valid rows from the same synthetic distribution
@@ -51,12 +58,14 @@ def run_at_scale(rows, args, hist_method="auto"):
     Xv, yv = X[n:], y[n:]
     X, y = X[:n], y[:n]
     phases["datagen"] = time.time() - t0
+    mark("datagen")
 
     t0 = time.time()
     ds = lgb.Dataset(X, label=y, params={"max_bin": args.max_bin,
                                          "verbosity": -1})
     ds.construct()
     phases["construct"] = time.time() - t0
+    mark("construct")
 
     booster = lgb.Booster(params={
         "objective": "binary", "num_leaves": args.num_leaves,
@@ -70,9 +79,11 @@ def run_at_scale(rows, args, hist_method="auto"):
     t0 = time.time()
     booster.update()
     phases["first_iter_incl_compile"] = time.time() - t0
+    mark("first_iter_incl_compile")
     t0 = time.time()
     booster.update()
     phases["second_iter"] = time.time() - t0
+    mark("second_iter")
 
     # drain outstanding async work so warmup doesn't leak into the timing
     _ = float(booster._boosting.train_score[0])
@@ -84,6 +95,7 @@ def run_at_scale(rows, args, hist_method="auto"):
     _ = float(booster._boosting.train_score[0])
     sec_per_iter = (time.time() - t0) / args.iters
     phases["sec_per_iter"] = sec_per_iter
+    mark(f"timed_iters ({sec_per_iter:.3f} s/iter)")
 
     # quality anchor: continue to --rounds total iterations, then held-out
     # AUC (speed without a matched-accuracy number is unfalsifiable)
@@ -95,6 +107,7 @@ def run_at_scale(rows, args, hist_method="auto"):
             booster.update()
         _ = float(booster._boosting.train_score[0])
         phases["extra_rounds"] = time.time() - t0
+        mark("extra_rounds")
     if n_valid > 0:
         t0 = time.time()
         score = booster.predict(Xv, raw_score=True)
@@ -108,6 +121,7 @@ def run_at_scale(rows, args, hist_method="auto"):
             auc = float((ranks[yv > 0].sum() - npos * (npos + 1) / 2)
                         / (npos * nneg))
         phases["valid_auc_predict"] = time.time() - t0
+        mark(f"valid_auc_predict (auc={auc})")
     return sec_per_iter, phases, auc, max(args.rounds, done)
 
 
