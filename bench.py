@@ -9,10 +9,13 @@ values, so sec/iter is comparable.
 
 Runs a fallback ladder (10.5M -> 2M -> 500k rows) so an OOM or compile
 failure at full scale still reports a number at the largest completing
-scale. Prints a per-phase breakdown to stderr and ONE JSON line to stdout:
-{"metric", "value", "unit", "vs_baseline", ...} where vs_baseline =
-reference_sec_per_iter / ours, scaled to the rows actually run (>1 means
-faster than the reference CPU baseline at that scale).
+scale. Prints a per-phase breakdown to stderr and result JSON lines to
+stdout: {"metric", "value", "unit", "vs_baseline", ...} where vs_baseline
+= reference_sec_per_iter / ours, scaled to the rows actually run (>1 means
+faster than the reference CPU baseline at that scale). The headline line
+prints as soon as the main run completes (insurance against a tunnel
+wedge during the secondary q8/bin63 probes) and again, enriched with the
+probe fields, at the end — parsers must take the LAST JSON line.
 """
 
 import argparse
@@ -36,6 +39,14 @@ def run_at_scale(rows, args, hist_method="auto"):
     import numpy as np
     import jax
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import profiling
+
+    # TIMETAG scopes force a host sync per phase to attribute wall time —
+    # exactly what the async-pipelined steady state must NOT do. Collect
+    # the table from the two warmup iterations only, then run the timed
+    # loop (and everything after) sync-free.
+    profiling.reset()
+    profiling.enable(True)
 
     def mark(name):
         # stream phase completions so a wedged tunnel RPC is attributable
@@ -84,6 +95,11 @@ def run_at_scale(rows, args, hist_method="auto"):
     booster.update()
     phases["second_iter"] = time.time() - t0
     mark("second_iter")
+    print(f"# ---- TIMETAG phase table ({hist_method}, warmup iters) ----",
+          file=sys.stderr)
+    for line in profiling.table().splitlines():
+        print(f"# {line}", file=sys.stderr)
+    profiling.enable(False)
 
     # drain outstanding async work so warmup doesn't leak into the timing
     _ = float(booster._boosting.train_score[0])
@@ -126,6 +142,7 @@ def run_at_scale(rows, args, hist_method="auto"):
 
 
 def main():
+    t_main = time.time()
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=FULL_ROWS)
     ap.add_argument("--features", type=int, default=28)
@@ -137,6 +154,9 @@ def main():
                     help="total boosting rounds before the AUC readout")
     ap.add_argument("--valid-rows", type=int, default=500_000,
                     help="held-out rows for the AUC readout (0 disables)")
+    ap.add_argument("--probe-deadline", type=int, default=2400,
+                    help="stop starting secondary probes (q8/bin63) after "
+                         "this many seconds of total wall time")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     ap.add_argument("--no-ladder", action="store_true",
                     help="fail instead of retrying at smaller scales")
@@ -206,11 +226,45 @@ def main():
                           "error": "all ladder scales failed"}))
         sys.exit(1)
 
-    from lightgbm_tpu.utils import profiling
-    print("# ---- phase timer table (LIGHTGBM_TPU_TIMETAG) ----",
+    # baseline scaled to the rows actually benchmarked (reference cost is
+    # ~linear in rows at fixed features/bins/leaves)
+    scaled_baseline = BASELINE_SEC_PER_ITER * used_rows / FULL_ROWS
+    # MFU estimate: nominal useful work of dense histogram construction,
+    # ~log2(num_leaves) full-data passes per tree with subtraction
+    # (2*N*F*B*S flops per pass), over the measured wall time
+    import math
+    nominal_flops = (2.0 * used_rows * args.features * args.max_bin * 3
+                     * math.ceil(math.log2(max(args.num_leaves, 2))))
+    mfu = nominal_flops / sec_per_iter / PEAK_F32_FLOPS
+    print(f"# MFU estimate (dense-hist useful flops / f32 peak): {mfu:.4f}",
           file=sys.stderr)
-    for line in profiling.table().splitlines():
-        print(f"# {line}", file=sys.stderr)
+
+    result = {
+        "metric": f"higgs{used_rows/1e6:.1f}M_sec_per_iter",
+        "value": round(sec_per_iter, 4),
+        "unit": f"s/iter ({used_rows} rows x {args.features} feat, "
+                f"{args.num_leaves} leaves, {args.max_bin} bins, binary)",
+        "vs_baseline": round(scaled_baseline / sec_per_iter, 4),
+        "rows": used_rows,
+        "mfu_est": round(mfu, 4),
+        "auc": round(auc, 6) if auc is not None else None,
+        "auc_rounds": rounds_run,
+        "hist_method": used_method,
+        "phases": {k: round(v, 3) for k, v in phases.items()},
+    }
+    # insurance: print the headline line NOW — a later probe that wedges
+    # the tunnel (observed 2026-07-31) must not cost the round its number.
+    # The final enriched line is printed again below; parsers that take
+    # the last JSON line get the probes too.
+    print(json.dumps(result), flush=True)
+
+    def probe_headroom(label):
+        left = args.probe_deadline - (time.time() - t_main)
+        if left < 0:
+            print(f"# skipping {label} probe: past --probe-deadline "
+                  f"({args.probe_deadline}s)", file=sys.stderr)
+            return False
+        return True
 
     # secondary probe: the opt-in int8 quantized-gradient mode, WITH its
     # own held-out AUC so quality-at-speed is on record (the promotion
@@ -218,7 +272,8 @@ def main():
     # path — the same tolerance the reference publishes for its GPU
     # float32-histogram mode, docs/GPU-Performance.rst:133-140)
     q8_sec = q8_auc = None
-    if used_method == "auto" and jax.default_backend() == "tpu":
+    if (used_method == "auto" and jax.default_backend() == "tpu"
+            and probe_headroom("q8")):
         try:
             q8_sec, q8_ph, q8_auc, _ = run_at_scale(
                 used_rows, args, hist_method="pallas_q8")
@@ -237,7 +292,7 @@ def main():
     # speed-at-matched-quality is on the record.
     b63_sec = b63_auc = b63q8_sec = b63q8_auc = None
     if (used_method == "auto" and jax.default_backend() == "tpu"
-            and args.max_bin != 63):
+            and args.max_bin != 63 and probe_headroom("bin63")):
         try:
             b63_args = argparse.Namespace(**{**vars(args), "max_bin": 63})
             b63_sec, b63_ph, b63_auc, _ = run_at_scale(
@@ -251,42 +306,18 @@ def main():
             print("# max_bin=63 probe failed; omitting", file=sys.stderr)
         # the two levers COMBINED (4x fewer MACs x 2x int8 MXU rate) —
         # the projected fastest configuration, with its own AUC readout
-        try:
-            b63q8_sec, _, b63q8_auc, _ = run_at_scale(
-                used_rows, b63_args, hist_method="pallas_q8")
-            print(f"# max_bin=63 + q8: {b63q8_sec:.3f} s/iter, "
-                  f"auc={b63q8_auc}", file=sys.stderr)
-        except Exception:
-            traceback.print_exc(file=sys.stderr)
-            print("# max_bin=63+q8 probe failed; omitting", file=sys.stderr)
+        if probe_headroom("bin63+q8"):
+            try:
+                b63q8_sec, _, b63q8_auc, _ = run_at_scale(
+                    used_rows, b63_args, hist_method="pallas_q8")
+                print(f"# max_bin=63 + q8: {b63q8_sec:.3f} s/iter, "
+                      f"auc={b63q8_auc}", file=sys.stderr)
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+                print("# max_bin=63+q8 probe failed; omitting",
+                      file=sys.stderr)
 
-    for k, v in phases.items():
-        print(f"# phase {k}: {v:.3f}s", file=sys.stderr)
-
-    # baseline scaled to the rows actually benchmarked (reference cost is
-    # ~linear in rows at fixed features/bins/leaves)
-    scaled_baseline = BASELINE_SEC_PER_ITER * used_rows / FULL_ROWS
-    # MFU estimate: nominal useful work of dense histogram construction,
-    # ~log2(num_leaves) full-data passes per tree with subtraction
-    # (2*N*F*B*S flops per pass), over the measured wall time
-    import math
-    nominal_flops = (2.0 * used_rows * args.features * args.max_bin * 3
-                     * math.ceil(math.log2(max(args.num_leaves, 2))))
-    mfu = nominal_flops / sec_per_iter / PEAK_F32_FLOPS
-    print(f"# MFU estimate (dense-hist useful flops / f32 peak): {mfu:.4f}",
-          file=sys.stderr)
-
-    print(json.dumps({
-        "metric": f"higgs{used_rows/1e6:.1f}M_sec_per_iter",
-        "value": round(sec_per_iter, 4),
-        "unit": f"s/iter ({used_rows} rows x {args.features} feat, "
-                f"{args.num_leaves} leaves, {args.max_bin} bins, binary)",
-        "vs_baseline": round(scaled_baseline / sec_per_iter, 4),
-        "rows": used_rows,
-        "mfu_est": round(mfu, 4),
-        "auc": round(auc, 6) if auc is not None else None,
-        "auc_rounds": rounds_run,
-        "hist_method": used_method,
+    result.update({
         "q8_sec_per_iter": round(q8_sec, 4) if q8_sec is not None else None,
         "q8_auc": round(q8_auc, 6) if q8_auc is not None else None,
         "bin63_sec_per_iter": round(b63_sec, 4) if b63_sec is not None
@@ -296,8 +327,8 @@ def main():
         if b63q8_sec is not None else None,
         "bin63_q8_auc": round(b63q8_auc, 6) if b63q8_auc is not None
         else None,
-        "phases": {k: round(v, 3) for k, v in phases.items()},
-    }))
+    })
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

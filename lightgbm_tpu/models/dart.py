@@ -38,6 +38,10 @@ class DART(GBDT):
     """reference: dart.hpp:23 `class DART: public GBDT`."""
 
     name = "dart"
+    # dropout renormalization rescales stored host trees every iteration
+    # (dart.hpp Normalize) — the lazy host-mirror pipeline would flush
+    # per-iteration anyway, so keep the synchronous path
+    _supports_lazy_host = False
 
     def __init__(self, config: Config, train_set: Optional[Dataset] = None,
                  objective: Optional[ObjectiveFunction] = None):

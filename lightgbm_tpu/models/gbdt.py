@@ -111,7 +111,17 @@ class GBDT:
         self.train_set = train_set
         self.objective = objective
         self.trees: List[TreeArrays] = []       # device trees, leaf_value shrunk
-        self.host_trees: List[HostTree] = []
+        self._host_trees: List[HostTree] = []
+        # host-mirror pipeline: device trees whose host fetch is in flight
+        # (index into _host_trees, device TreeArrays). See host_trees below.
+        self._pending_host: List[Tuple[int, TreeArrays]] = []
+        # lagged no-split stop: count splitless flushed trees PER ITERATION
+        # group (tree index // num_tree_per_iteration) — the reference stop
+        # condition is one whole iteration without a split, so the count
+        # must not straddle iteration boundaries
+        self._splitless_group = -1
+        self._splitless_in_group = 0
+        self._lagged_stop = False    # a full splitless iteration was flushed
         self.num_class = max(config.num_class, 1)
         self.num_tree_per_iteration = 1
         self.init_scores: List[float] = []
@@ -131,6 +141,65 @@ class GBDT:
         self.best_score: Dict[str, Dict[str, float]] = {}
         if train_set is not None:
             self._init_train(train_set)
+
+    # ------------------------------------------------- host-tree pipeline
+    @property
+    def host_trees(self) -> List["HostTree"]:
+        """Host mirrors of ``self.trees``. In the lazy fast path the mirror
+        fetch is ASYNC (copy_to_host_async at dispatch time) and pending
+        slots hold None until consumed here — every reader goes through
+        this property, so no consumer can observe a placeholder. The point:
+        a blocking ``jax.device_get`` per iteration costs a full host
+        round-trip (~75-93 ms through a TPU tunnel) and serializes the
+        dispatch pipeline; deferring it lets XLA queue iterations
+        back-to-back (the same reason the reference keeps its tree on the
+        training thread and only serializes at save time)."""
+        self._flush_pending()
+        return self._host_trees
+
+    def _flush_pending(self, only_ready: bool = False) -> None:
+        """Materialize pending host mirrors in FIFO order. With
+        ``only_ready`` stop at the first tree whose device computation has
+        not finished (non-blocking progress check for the lagged no-split
+        stop signal)."""
+        while self._pending_host:
+            idx, tree_dev = self._pending_host[0]
+            if only_ready:
+                try:
+                    if not tree_dev.num_leaves.is_ready():
+                        break
+                except AttributeError:   # backend without is_ready()
+                    break
+            self._pending_host.pop(0)
+            t_host = jax.device_get(tree_dev)
+            self._host_trees[idx] = self._make_host_tree(t_host)
+            # the reference stops when an iteration can add no split
+            # (gbdt.cpp:404-435); lagged detection: a full iteration of
+            # flushed splitless trees arms the stop flag (group = the
+            # iteration this tree belongs to; a whole iteration takes the
+            # same lazy/sync path, so a flushed group is complete)
+            group = idx // self.num_tree_per_iteration
+            if group != self._splitless_group:
+                self._splitless_group = group
+                self._splitless_in_group = 0
+            if int(t_host.num_leaves) <= 1:
+                self._splitless_in_group += 1
+                if self._splitless_in_group >= self.num_tree_per_iteration:
+                    self._lagged_stop = True
+
+    def _lazy_host_ok(self) -> bool:
+        """Whether this iteration can defer the host tree fetch: nothing in
+        the iteration itself needs host-side tree data. First iteration
+        stays synchronous (boost-from-average bias fold + the TIMETAG
+        first-iter sample); leaf-renewal objectives rewrite leaf values on
+        host before the score update; linear trees fit on host."""
+        return (self._supports_lazy_host
+                and self.iter >= 1
+                and not self.config.linear_tree
+                and not (self.objective is not None
+                         and self.objective.need_renew_tree_output))
+
+    _supports_lazy_host = True   # DART/RF override: they touch host trees
 
     # ------------------------------------------------------------ setup
     def _init_train(self, train_set: Dataset) -> None:
@@ -546,17 +615,33 @@ class GBDT:
                 first_tree = len(self.trees) < k and self.loaded_iters == 0
                 lin = self._fit_linear_leaves(tree, leaf_id, gc, hc, mask,
                                               first_tree)
+            lazy = lin is None and self._lazy_host_ok()
             with profiling.timer("finalize_tree"):
-                tree, t_host, had_split = self._finalize_tree(tree, leaf_id, c)
+                if lazy:
+                    # shrink on device only; the host mirror fetch is async
+                    # (see host_trees) — no blocking round-trip this iter
+                    lr = self.shrinkage_rate
+                    tree = tree._replace(leaf_value=tree.leaf_value * lr,
+                                         node_value=tree.node_value * lr,
+                                         shrinkage=tree.shrinkage * lr)
+                    t_host, had_split = None, True
+                else:
+                    tree, t_host, had_split = self._finalize_tree(
+                        tree, leaf_id, c)
             no_split = no_split and not had_split
             with profiling.timer("score_update", sync=None):
                 if lin is not None:
                     self._add_tree(tree, leaf_id, c, linear=lin, t_host=t_host)
                 else:
-                    self._add_tree(tree, leaf_id, c, t_host=t_host)
+                    self._add_tree(tree, leaf_id, c, t_host=t_host, lazy=lazy)
                 self._bias_after_score(c, had_split)
         self.iter += 1
-        return no_split
+        # lagged no-split detection for lazy iterations: consume whatever
+        # mirrors already finished (non-blocking) and report the stop one
+        # or more iterations late — the extra trees are splitless zero
+        # trees, prediction-identical to stopping on time
+        self._flush_pending(only_ready=True)
+        return no_split or self._lagged_stop
 
     def _grow_one(self, gc: jax.Array, hc: jax.Array, mask: jax.Array,
                   fmask: jax.Array, iter_key: jax.Array, hm: str):
@@ -565,6 +650,16 @@ class GBDT:
         factory-selected learner, tree_learner.h:104)."""
         cfg = self.config
         ts = self.train_set
+        if ts.bins.shape[1] == 0:
+            # every feature pre-filtered as trivial (e.g. min_data_in_leaf
+            # too large for the data — the reference's feature_pre_filter,
+            # dataset_loader.cpp:647-648): train a splitless constant tree
+            # like the reference instead of dispatching a 0-feature grower
+            from .tree import empty_tree
+            n = (ts.num_local_data if getattr(self, "_pre_part", False)
+                 else ts.num_data)
+            return (empty_tree(cfg.num_leaves),
+                    jnp.zeros((n,), dtype=jnp.int32), None)
         if self._parallel_grower is not None:
             return self._parallel_grower(
                 ts.bins, gc, hc, mask,
@@ -809,12 +904,14 @@ class GBDT:
 
     def _add_tree(self, tree: TreeArrays, leaf_id: jax.Array, class_idx: int,
                   linear: Optional[dict] = None,
-                  t_host: Optional[TreeArrays] = None) -> None:
+                  t_host: Optional[TreeArrays] = None,
+                  lazy: bool = False) -> None:
         """Score updates for train (via leaf ids — no traversal needed) and
         valid sets (tree traversal on their binned matrices). ``linear``
         carries a fitted linear-leaf model: per-row train deltas plus the
         const/coeff tables (reference: Tree::AddPredictionToScore linear
-        branch, tree.h). ``t_host`` is the already-fetched numpy mirror."""
+        branch, tree.h). ``t_host`` is the already-fetched numpy mirror;
+        with ``lazy`` the mirror is deferred (async copy, see host_trees)."""
         from .tree import leaf_values_of_rows
         lr = self.shrinkage_rate
         if linear is not None:
@@ -826,7 +923,16 @@ class GBDT:
         else:
             self.train_score = self.train_score + delta
         self.trees.append(tree)
-        self._append_host_tree(t_host if t_host is not None else tree)
+        if lazy:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                try:
+                    leaf.copy_to_host_async()
+                except AttributeError:
+                    pass
+            self._host_trees.append(None)
+            self._pending_host.append((len(self._host_trees) - 1, tree))
+        else:
+            self._append_host_tree(t_host if t_host is not None else tree)
         if linear is not None:
             ht = self.host_trees[-1]
             ht.is_linear = True
@@ -1017,6 +1123,11 @@ class GBDT:
         """reference: gbdt.cpp:454-470 RollbackOneIter."""
         if self.iter <= 0:
             return
+        self._flush_pending()
+        # the popped iteration must not leave a stale stop signal behind
+        self._lagged_stop = False
+        self._splitless_group = -1
+        self._splitless_in_group = 0
         if getattr(self, "_pre_part", False):
             # the rollback delta re-traverses the train bins, which are
             # globally sharded here; per-shard traversal is not wired up

@@ -34,6 +34,9 @@ class RF(GBDT):
 
     name = "rf"
     average_output = True
+    # RF folds its per-tree bias into host trees each iteration
+    # (rf.hpp:133-137) — keep the synchronous finalize path
+    _supports_lazy_host = False
 
     def __init__(self, config: Config, train_set: Optional[Dataset] = None,
                  objective: Optional[ObjectiveFunction] = None):
@@ -122,7 +125,9 @@ class RF(GBDT):
         self.tree_bias.append(0.0)
 
     def _add_tree(self, tree: TreeArrays, leaf_id, class_idx: int,
-                  linear=None, t_host=None) -> None:
+                  linear=None, t_host=None, lazy: bool = False) -> None:
+        # ``lazy`` is always False here (_supports_lazy_host = False);
+        # accepted for signature compatibility with the GBDT call site
         """Running-mean score update (rf.hpp:139-141):
         score <- (score * m + tree_pred) / (m + 1)."""
         from .tree import leaf_values_of_rows, predict_value_bins

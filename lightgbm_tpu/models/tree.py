@@ -104,6 +104,13 @@ def predict_leaf_bins(tree: TreeArrays, bins: jax.Array,
     Returns [N] int32 leaf indices.
     """
     n = bins.shape[0]
+    if bins.shape[1] == 0:
+        # 0-feature dataset (every feature pre-filtered as trivial): all
+        # trees are splitless, every row lands in leaf 0; pad one dummy
+        # column so the gathers below stay well-formed for the traversal
+        # machinery (which never routes anywhere for a 1-leaf tree anyway)
+        bins = jnp.zeros((n, 1), dtype=bins.dtype)
+        missing_bin = jnp.full((1,), -1, dtype=jnp.int32)
     rows = jnp.arange(n, dtype=jnp.int32)
 
     def cond(state):

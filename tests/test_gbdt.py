@@ -205,3 +205,22 @@ def test_bagging_subset_path_end_to_end(binary_example):
     np.testing.assert_array_equal(p1, p2)               # device PRNG seeded
     acc = np.mean((p1 > 0.5) == (y > 0.5))
     assert acc > 0.70, acc   # no-bagging baseline is 0.707 at these settings
+
+
+def test_all_features_prefiltered_constant_trees(rng):
+    """min_data_in_leaf too large for the data pre-filters EVERY feature
+    as trivial (reference: feature_pre_filter, dataset_loader.cpp:647-648).
+    The reference then trains splitless constant trees and stops; the
+    0-column device matrix must not crash the grower or predict."""
+    X = rng.normal(size=(200, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    booster = lgb.Booster(params={"objective": "binary", "num_leaves": 7,
+                                  "min_data_in_leaf": 150, "verbosity": -1},
+                          train_set=lgb.Dataset(X, label=y))
+    assert booster.update() is True          # no split -> early stoppable
+    import math
+    avg = math.log(y.mean() / (1 - y.mean()))
+    pred = booster.predict(X[:4], raw_score=True)
+    np.testing.assert_allclose(pred, avg, rtol=1e-5)
+    assert (booster.predict(X[:4], pred_leaf=True) == 0).all()
+    assert "tree" in booster.model_to_string()
