@@ -226,6 +226,7 @@ def maybe_init_from_config(config) -> None:
 
 
 def spawn(fn, nproc: int = 2, args: tuple = (),
+          per_rank_args: Optional[list] = None,
           devices_per_proc: Optional[int] = None,
           timeout: Optional[float] = 600.0):
     """Run ``fn(rank, *args)`` in ``nproc`` freshly spawned local processes
@@ -242,20 +243,28 @@ def spawn(fn, nproc: int = 2, args: tuple = (),
     virtual CPU device count (tests), otherwise children inherit the
     environment. ``timeout`` is the OVERALL deadline for all ranks; a
     child that dies without reporting fails fast with its exit code.
-    Returns rank 0's return value (must be picklable); raises
+    With ``per_rank_args`` (length nproc), rank r is called
+    ``fn(r, per_rank_args[r], *args)`` — each child ships ONLY its own
+    payload (a worker's data partition must not be pickled to every other
+    worker). Returns rank 0's return value (must be picklable); raises
     RuntimeError with the failing rank's traceback on error.
     """
     import multiprocessing as mp
     import queue as _queue
     import time as _time
 
+    if per_rank_args is not None and len(per_rank_args) != nproc:
+        raise ValueError(f"per_rank_args has {len(per_rank_args)} entries "
+                         f"for {nproc} ranks")
     port = free_port()
     machines = ",".join(f"127.0.0.1:{port}" for _ in range(nproc))
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [ctx.Process(
         target=_spawn_child,
-        args=(q, fn, r, nproc, machines, devices_per_proc, args))
+        args=(q, fn, r, nproc, machines, devices_per_proc,
+              args if per_rank_args is None
+              else (per_rank_args[r],) + tuple(args)))
         for r in range(nproc)]
     for p in procs:
         p.start()
@@ -340,6 +349,66 @@ def _spawn_child(q, fn, rank, nproc, machines, devices_per_proc, args):
         q.put((rank, True, result))
     except BaseException:
         q.put((rank, False, traceback.format_exc()))
+
+
+def _train_part(rank, part, params, num_boost_round, train_kwargs):
+    """Per-worker body of ``train_distributed`` (module-level so spawn can
+    pickle it): build the local pre-partitioned Dataset, run the standard
+    train loop (collectives ride the jitted programs), return the model
+    text — the exact shape of the reference's dask ``_train_part``
+    (python-package/lightgbm/dask.py:73-124)."""
+    from .engine import train as _train
+    ds = load_partitioned(part["data"], label=part.get("label"),
+                          weight=part.get("weight"),
+                          init_score=part.get("init_score"),
+                          params=params)
+    booster = _train(params, ds, num_boost_round, **train_kwargs)
+    return booster.model_to_string()
+
+
+def train_distributed(params, parts, num_boost_round: int = 100,
+                      devices_per_proc: Optional[int] = None,
+                      timeout: Optional[float] = 900.0,
+                      **train_kwargs):
+    """Distributed training over pre-partitioned data, orchestrated like
+    the reference's Dask layer (python-package/lightgbm/dask.py:211-330
+    ``_train``: co-locate partitions per worker, find an open port, inject
+    machines/num_machines per worker, run local fits, return the rank-0
+    model).
+
+    Args:
+      params: training params; ``tree_learner`` defaults to "data" and must
+        be one of data/voting/feature (the same restriction the reference's
+        dask layer enforces, dask.py:301-311).
+      parts: one dict per worker — {"data": X, "label": y,
+        "weight": optional, "init_score": optional}. Each worker sees ONLY
+        its part (the reference's data_parallel pre-partitioned mode:
+        data never leaves its machine, dataset_loader.cpp:182-258).
+      num_boost_round: boosting rounds.
+      devices_per_proc: force N virtual CPU devices per worker (tests).
+      timeout: overall deadline handed to ``spawn``.
+      **train_kwargs: forwarded to ``engine.train`` in each worker.
+
+    Returns the trained Booster (rank 0's model, loaded locally).
+    """
+    params = dict(params or {})
+    learner = str(params.get("tree_learner", "data") or "data")
+    allowed = {"data", "voting", "feature"}
+    if learner not in allowed:
+        log.fatal(f"train_distributed requires tree_learner in {allowed} "
+                  f"(got {learner!r}) — the reference's dask layer has the "
+                  f"same restriction (dask.py:301-311)")
+    params["tree_learner"] = learner
+    if "num_machines" in params:
+        nm = int(params["num_machines"])
+        if nm != len(parts):
+            log.fatal(f"num_machines={nm} but {len(parts)} parts given")
+    model_str = spawn(_train_part, nproc=len(parts),
+                      args=(params, num_boost_round, dict(train_kwargs)),
+                      per_rank_args=list(parts),
+                      devices_per_proc=devices_per_proc, timeout=timeout)
+    from .booster import Booster
+    return Booster(params=params, model_str=model_str)
 
 
 def allgather_f64(arr):

@@ -99,6 +99,15 @@ def _bagging_subset(key: jax.Array, bins: jax.Array, k: int):
     return mask, sub_idx, sub_bins, sub_bins.T
 
 
+def _shrink_tree(tree: TreeArrays, lr: float) -> TreeArrays:
+    """Apply the learning rate to a tree's value-bearing fields
+    (Tree::Shrinkage, tree.h:187). Works on device or host-mirrored
+    TreeArrays — the single definition both finalize paths share."""
+    return tree._replace(leaf_value=tree.leaf_value * lr,
+                         node_value=tree.node_value * lr,
+                         shrinkage=tree.shrinkage * lr)
+
+
 class GBDT:
     """Gradient Boosting Decision Tree (reference: gbdt.h:42, boosting.h:27)."""
 
@@ -620,10 +629,7 @@ class GBDT:
                 if lazy:
                     # shrink on device only; the host mirror fetch is async
                     # (see host_trees) — no blocking round-trip this iter
-                    lr = self.shrinkage_rate
-                    tree = tree._replace(leaf_value=tree.leaf_value * lr,
-                                         node_value=tree.node_value * lr,
-                                         shrinkage=tree.shrinkage * lr)
+                    tree = _shrink_tree(tree, self.shrinkage_rate)
                     t_host, had_split = None, True
                 else:
                     tree, t_host, had_split = self._finalize_tree(
@@ -856,12 +862,8 @@ class GBDT:
                 t_host = t_host._replace(leaf_value=lv)
                 tree = tree._replace(leaf_value=jnp.asarray(lv))
         lr = self.shrinkage_rate
-        tree = tree._replace(leaf_value=tree.leaf_value * lr,
-                             node_value=tree.node_value * lr,
-                             shrinkage=tree.shrinkage * lr)
-        t_host = t_host._replace(leaf_value=t_host.leaf_value * lr,
-                                 node_value=t_host.node_value * lr,
-                                 shrinkage=t_host.shrinkage * lr)
+        tree = _shrink_tree(tree, lr)
+        t_host = _shrink_tree(t_host, lr)
         return tree, t_host, had_split
 
     def _renew_score(self, class_idx: int) -> np.ndarray:
