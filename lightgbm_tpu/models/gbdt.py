@@ -452,6 +452,12 @@ class GBDT:
         if mode not in PARALLEL_MODES:
             log.fatal(f"Unknown tree learner type {mode}")
         unsupported = []
+        if getattr(self.train_set, "has_sparse_cols", False):
+            # construct() only extracts sparse columns when the params it
+            # saw said tree_learner=serial; reaching here means the Booster
+            # was configured differently from the Dataset
+            unsupported.append("sparse device storage (construct the "
+                               "Dataset with enable_sparse=false)")
         if self._cegb_mode != "off":
             unsupported.append("CEGB")
         if self._with_interactions:
@@ -532,7 +538,11 @@ class GBDT:
                       and cfg.neg_bagging_fraction >= 1.0
                       and self._parallel_grower is None
                       and self._cegb_mode == "off"
-                      and not cfg.linear_tree)
+                      and not cfg.linear_tree
+                      # sparse streams index ORIGINAL row ids; the subset
+                      # copy compacts rows, so it takes the mask path
+                      and not getattr(self.train_set, "has_sparse_cols",
+                                      False))
         key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed),
                                  self.iter)
         if use_subset:
@@ -656,7 +666,8 @@ class GBDT:
         factory-selected learner, tree_learner.h:104)."""
         cfg = self.config
         ts = self.train_set
-        if ts.bins.shape[1] == 0:
+        if ts.bins.shape[1] == 0 and not getattr(ts, "has_sparse_cols",
+                                                 False):
             # every feature pre-filtered as trivial (e.g. min_data_in_leaf
             # too large for the data — the reference's feature_pre_filter,
             # dataset_loader.cpp:647-648): train a splitless constant tree
@@ -717,7 +728,15 @@ class GBDT:
             rng_key=iter_key,
             bundle_meta=ts.bundle_meta,
             forced_splits=self._forced_splits,
-            hist_dp=self._hist_dp)
+            hist_dp=self._hist_dp,
+            sp_cols=tuple(int(c) for c in ts.sp_cols)
+            if getattr(ts, "has_sparse_cols", False) else (),
+            sp_rows=ts.sp_rows if getattr(ts, "has_sparse_cols", False)
+            else None,
+            sp_bins=ts.sp_bins if getattr(ts, "has_sparse_cols", False)
+            else None,
+            sp_default=ts.sp_default
+            if getattr(ts, "has_sparse_cols", False) else None)
 
     def _use_binsT(self, hm: str) -> bool:
         """The feature-major bins copy doubles the dominant array; above
@@ -772,7 +791,8 @@ class GBDT:
                        or (self._with_monotone
                            and self._mono_mode != "basic")
                        or subset_possible or self._hist_dp
-                       or hm.endswith("_q8"))
+                       or hm.endswith("_q8")
+                       or getattr(ts, "has_sparse_cols", False))
         if unsupported:
             if not getattr(self, "_warned_pool", False):
                 self._warned_pool = True
@@ -1135,6 +1155,11 @@ class GBDT:
             # globally sharded here; per-shard traversal is not wired up
             log.fatal("rollback_one_iter is not supported with "
                       "pre-partitioned Datasets")
+        if getattr(self.train_set, "has_sparse_cols", False):
+            # same reason: the traversal needs the full-width bin matrix,
+            # which sparse storage no longer materializes
+            log.fatal("rollback_one_iter is not supported with sparse "
+                      "device storage (construct with enable_sparse=false)")
         k = self.num_tree_per_iteration
         # tree count returns to a previously-seen value after retraining,
         # so the count-keyed contrib cache would serve the popped trees

@@ -56,6 +56,7 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.histogram import histogram_tiles
 from ..ops.split import (FeatureMeta, SplitInfo, SplitParams,
@@ -261,9 +262,15 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
                  cegb_lazy: bool,
                  mono_intermediate: bool = False,
                  sub_bins: jax.Array | None = None,
-                 sub_binsT: jax.Array | None = None) -> Tuple[GrowState, jax.Array]:
+                 sub_binsT: jax.Array | None = None,
+                 sp: tuple | None = None) -> Tuple[GrowState, jax.Array]:
     """Split the current best leaf (reference: SerialTreeLearner::Split,
-    serial_tree_learner.cpp:564-682 + Tree::Split, tree.h:62)."""
+    serial_tree_learner.cpp:564-682 + Tree::Split, tree.h:62).
+
+    ``sp``: sparse-column pack (sp_rows, sp_bins, sp_default, col2dense,
+    col2sp, is_sparse) when some device columns live as streams — the
+    split column is then reconstructed on demand for routing (the analog
+    of SparseBin::Split's stream walk, sparse_bin.hpp)."""
     l = jnp.argmax(gain_eff).astype(jnp.int32)
     best = state.best
     tree = state.tree
@@ -283,11 +290,26 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
     # the column extraction a contiguous dynamic slice instead of a strided
     # read of the whole row-major matrix (matters at 10M+ rows).
     def route(bins_m, binsT_m, leaf_vec):
-        if binsT_m is not None:
-            colv = jax.lax.dynamic_slice_in_dim(binsT_m, feat, 1,
-                                                0)[0].astype(jnp.int32)
+        fidx = feat if sp is None else sp[3][feat]        # dense position
+        if bins_m is not None and bins_m.shape[1] > 0:
+            if binsT_m is not None:
+                colv = jax.lax.dynamic_slice_in_dim(binsT_m, fidx, 1,
+                                                    0)[0].astype(jnp.int32)
+            else:
+                colv = jnp.take(bins_m, fidx, axis=1).astype(jnp.int32)
         else:
-            colv = jnp.take(bins_m, feat, axis=1).astype(jnp.int32)
+            colv = jnp.zeros((leaf_vec.shape[0],), jnp.int32)
+        if sp is not None:
+            sp_rows_, sp_bins_, sp_default_, _, col2sp_, is_sp_ = sp
+            scol = col2sp_[feat]
+            rowsv = jax.lax.dynamic_slice_in_dim(sp_rows_, scol, 1, 0)[0]
+            binsv = jax.lax.dynamic_slice_in_dim(sp_bins_, scol, 1, 0)[0]
+            base = jnp.full((leaf_vec.shape[0],), sp_default_[scol],
+                            jnp.int32)
+            # padded stream rows index out of range and are dropped
+            colv_sp = base.at[rowsv].set(binsv.astype(jnp.int32),
+                                         mode="drop")
+            colv = jnp.where(is_sp_[feat], colv_sp, colv)
         numl = jnp.where((colv == mb) & (mb >= 0), dleft, colv <= thr)
         # EFB bundle split: rows outside the owning member's segment are
         # its default mass and route by the default direction
@@ -430,7 +452,7 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
                      "use_bynode", "tile_leaves", "hist_block",
                      "hist_subtraction", "feature_block",
                      "feature_axis_name", "feature_shards", "voting",
-                     "vote_top_k", "hist_dp"))
+                     "vote_top_k", "hist_dp", "sp_cols"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
               feature_mask: jax.Array, missing_bin: jax.Array, *,
@@ -467,6 +489,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               bundle_meta=None,
               forced_splits=None,
               hist_dp: bool = False,
+              sp_cols: tuple = (),
+              sp_rows: jax.Array | None = None,
+              sp_bins: jax.Array | None = None,
+              sp_default: jax.Array | None = None,
               ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
     """Grow one tree. Returns (tree arrays, per-row leaf index, aux state).
 
@@ -537,7 +563,35 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         features' histograms are summed across devices before the final
         search (CopyLocalHistogram, :184+).
     """
-    n, f = bins.shape
+    n, f_dense = bins.shape
+    f_sp = len(sp_cols)
+    # f is the LOGICAL device-column count: meta/feature_mask/missing_bin
+    # and the histogram planes span all columns; ``bins`` holds only the
+    # dense ones (sparse columns live as (row, bin) streams, see
+    # Dataset._maybe_extract_sparse). Plane placement and routing go
+    # through the static sp_cols positions.
+    f = f_dense + f_sp
+    if f_sp:
+        assert (feature_axis_name is None and axis_name is None
+                and not voting and feature_block == 0
+                and sub_idx is None), (
+            "sparse device storage is serial-only (construct with "
+            "enable_sparse=false for parallel learners)")
+        sp_np = np.asarray(sp_cols, dtype=np.int32)
+        dense_np = np.asarray(
+            [c for c in range(f) if c not in set(sp_cols)], dtype=np.int32)
+        col2dense_np = np.zeros((f,), dtype=np.int32)
+        col2dense_np[dense_np] = np.arange(len(dense_np), dtype=np.int32)
+        col2sp_np = np.zeros((f,), dtype=np.int32)
+        col2sp_np[sp_np] = np.arange(f_sp, dtype=np.int32)
+        is_sp_np = np.zeros((f,), dtype=bool)
+        is_sp_np[sp_np] = True
+        sp_pack = (sp_rows, sp_bins, sp_default,
+                   jnp.asarray(col2dense_np), jnp.asarray(col2sp_np),
+                   jnp.asarray(is_sp_np))
+    else:
+        sp_np = dense_np = None
+        sp_pack = None
     L = max_leaves
     tile_leaves = tile_leaves or 42     # 0 = auto
     P = min(tile_leaves, L) if hist_method.startswith(("onehot", "pallas")) \
@@ -817,6 +871,49 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                              * cegb_lazy_penalty[None, :] * cnt_unused)
         return delta
 
+    def combine_sparse(tile, sel, hist_leaf_ids, stats):
+        """Histogram planes for the sparse columns: an O(nnz) scatter-add
+        of the non-default (row, bin) stream entries plus reconstruction of
+        the elided default bin from per-slot totals — the reference's
+        most_freq elision + FixHistogram (reference: sparse_bin.hpp
+        ConstructHistogram; FixHistogram decl dataset.h:506). Returns the
+        full [P, f, B, S] tile with dense planes at their column ids."""
+        acc = jnp.int32 if quant8 else hist_dtype
+        S = stats.shape[1]
+        valid = sp_rows < n                                   # [F_sp, M]
+        rclip = jnp.minimum(sp_rows, n - 1)
+        ent_leaf = hist_leaf_ids[rclip]                       # [F_sp, M]
+        # leaf -> tile slot via an O(L) lookup table (a [F_sp, M, P]
+        # equality tensor would dwarf the histogram itself at scale);
+        # inactive sel entries (-1) park their writes at index L, which no
+        # ent_leaf value ever reads
+        slot_map = jnp.full((L + 1,), P, jnp.int32).at[
+            jnp.where(sel >= 0, sel, L)].set(
+                jnp.arange(P, dtype=jnp.int32))
+        slot = slot_map[ent_leaf]
+        st = jnp.where(valid[:, :, None], stats[rclip].astype(acc), 0)
+        col = jnp.arange(f_sp, dtype=jnp.int32)[:, None]
+        idx = (slot * f_sp + col) * num_bins + sp_bins.astype(jnp.int32)
+        flat = jnp.zeros(((P + 1) * f_sp * num_bins, S), acc)
+        flat = flat.at[idx.reshape(-1)].add(st.reshape(-1, S))
+        sp_t = flat.reshape(P + 1, f_sp, num_bins, S)[:P]
+        # per-slot totals: any dense column's plane partitions all rows;
+        # without one, reduce the stats by slot directly
+        if f_dense > 0:
+            totals = tile[:, 0].sum(axis=1)                   # [P, S]
+        else:
+            eq_all = (hist_leaf_ids[:, None] == sel[None, :])
+            totals = jnp.einsum("np,ns->ps", eq_all.astype(acc),
+                                stats.astype(acc))
+        others = sp_t.sum(axis=2)                             # [P, F_sp, S]
+        defm = (jnp.arange(num_bins, dtype=jnp.int32)[None, :]
+                == sp_default[:, None])                       # [F_sp, B]
+        recon = (totals[:, None, :] - others)[:, :, None, :]
+        sp_t = jnp.where(defm[None, :, :, None], recon, sp_t)
+        full = jnp.zeros((P, f, num_bins, S), acc)
+        full = full.at[:, dense_np].set(tile)
+        return full.at[:, sp_np].set(sp_t)
+
     def tile_pass(state: GrowState) -> GrowState:
         """One histogram pass for a tile of up to P pending leaves, with the
         larger sibling of each computed pair derived by subtraction."""
@@ -843,9 +940,16 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         sel = jnp.where(chosen_ok, chosen, -1)
 
         hist_leaf_ids = state.leaf_id_sub if use_subset else state.leaf_id
-        tile = histogram_tiles(bins_h, stats, hist_leaf_ids, sel, num_bins,
-                               method=hist_method, dtype=hist_dtype,
-                               binsT=binsT_h, block=hist_block)
+        if f_dense > 0:
+            tile = histogram_tiles(bins_h, stats, hist_leaf_ids, sel,
+                                   num_bins, method=hist_method,
+                                   dtype=hist_dtype,
+                                   binsT=binsT_h, block=hist_block)
+        else:
+            tile = jnp.zeros((P, 0, num_bins, stats.shape[1]),
+                             jnp.int32 if quant8 else hist_dtype)
+        if f_sp:
+            tile = combine_sparse(tile, sel, hist_leaf_ids, stats)
         if dp_scatter:
             # the reference DP learner reduce-scatters histograms so each
             # machine receives only its owned features' global sums
@@ -1015,7 +1119,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             with_interactions=with_interactions,
             cegb_lazy=cegb_lazy,
             mono_intermediate=mono_intermediate,
-            sub_bins=sub_bins, sub_binsT=sub_binsT))
+            sub_bins=sub_bins, sub_binsT=sub_binsT, sp=sp_pack))
         return state._replace(done=state.num_leaves == num_leaves_before)
 
     def forced_phase(state: GrowState) -> GrowState:
@@ -1074,7 +1178,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                   with_interactions=with_interactions,
                                   cegb_lazy=cegb_lazy,
                                   mono_intermediate=mono_intermediate,
-                                  sub_bins=sub_bins, sub_binsT=sub_binsT)
+                                  sub_bins=sub_bins, sub_binsT=sub_binsT,
+                                  sp=sp_pack)
             return st2
 
         state = jax.lax.cond(ok, do_split, lambda s: s, state)
@@ -1205,7 +1310,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             with_monotone=with_monotone,
             with_interactions=with_interactions,
             cegb_lazy=False, mono_intermediate=False,
-            sub_bins=None, sub_binsT=None))
+            sub_bins=None, sub_binsT=None, sp=sp_pack))
         return state._replace(done=state.num_leaves == num_leaves_before)
 
     def outer_body(state: GrowState) -> GrowState:

@@ -1,0 +1,207 @@
+"""Sparse device storage (reference: sparse_bin.hpp SparseBin chosen at
+sparse_rate > kSparseThreshold, bin.h:39; most_freq elision reconstructed by
+FixHistogram, dataset.h:506). Here a >=90%-concentrated device column drops
+out of the dense [N, F] matrix into padded (row, bin) streams; histogram
+planes scatter O(nnz) entries and reconstruct the elided default bin from
+per-leaf totals.
+
+Parity model: counts are EXACT and the column reconstruction is bit-exact
+(asserted at unit level below); grad/hess sums differ from the dense path
+only by f32 accumulation ORDER (the default-bin cell is total minus
+non-default instead of a direct sum), so near-tied split gains can resolve
+differently — exactly the tolerance the reference accepts between its own
+dense/sparse and CPU/GPU paths (test_dual.py score-parity, not bit-parity).
+End-to-end tests therefore assert quality parity, unit tests exactness."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+
+
+def _sparse_frame(rng, n=2000, dense_f=4, sparse_f=3, nnz_frac=0.04):
+    """dense continuous columns + heavily-concentrated columns whose
+    non-default entries are informative."""
+    X = rng.normal(size=(n, dense_f + sparse_f)).astype(np.float64)
+    for j in range(dense_f, dense_f + sparse_f):
+        col = np.zeros(n)
+        nz = rng.choice(n, int(n * nnz_frac), replace=False)
+        col[nz] = rng.normal(size=len(nz)) + 2.0
+        X[:, j] = col
+    y = ((X[:, 0] + 3.0 * (X[:, dense_f] > 0) + 0.5 * X[:, 1]) > 0.5)
+    return X, y.astype(np.float64)
+
+
+def _fit(X, y, enable_sparse, extra=None, rounds=8):
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "enable_sparse": enable_sparse, "enable_bundle": False,
+              "histogram_method": "scatter", "verbosity": -1}
+    params.update(extra or {})
+    ds = lgb.Dataset(X, label=y, params=params)
+    booster = lgb.train(params, ds, rounds)
+    return ds, booster
+
+
+def _acc(b, X, y):
+    return float(np.mean((b.predict(X) > 0.5) == (y > 0.5)))
+
+
+def test_sparse_reconstruction_and_histogram_exactness(rng):
+    """Unit anchors: (a) every sparse column reconstructs bit-exactly from
+    its stream; (b) a sparse-path histogram tile matches the dense path
+    exactly on counts and to f32 accumulation-order tolerance on grads."""
+    X, y = _sparse_frame(rng)
+    common = {"objective": "binary", "enable_bundle": False,
+              "verbosity": -1}
+    ds_d = lgb.Dataset(X, label=y, params={**common,
+                                           "enable_sparse": False})
+    ds_d.construct()
+    ds_s = lgb.Dataset(X, label=y, params={**common, "enable_sparse": True})
+    ds_s.construct()
+    assert ds_s.has_sparse_cols and len(ds_s.sp_cols) >= 2
+    n = len(X)
+    bins_d = np.asarray(ds_d.bins)
+    sp_rows = np.asarray(ds_s.sp_rows)
+    sp_bins = np.asarray(ds_s.sp_bins)
+    sp_def = np.asarray(ds_s.sp_default)
+    for i, c in enumerate(ds_s.sp_cols):
+        col = np.full(n, sp_def[i], np.int64)
+        m = sp_rows[i] < n
+        col[sp_rows[i][m]] = sp_bins[i][m]
+        np.testing.assert_array_equal(col, bins_d[:, c].astype(np.int64))
+
+    # histogram tile: dense reference vs the sparse scatter + FixHistogram
+    from lightgbm_tpu.ops.histogram import histogram_tiles
+    B, P = ds_d.max_num_bins, 2
+    f_sp = len(ds_s.sp_cols)
+    lid = jnp.asarray(rng.randint(0, 2, n).astype(np.int32))
+    stats = jnp.asarray(np.stack([rng.normal(size=n),
+                                  np.abs(rng.normal(size=n)),
+                                  np.ones(n)], 1).astype(np.float32))
+    sel = jnp.asarray(np.array([0, 1], np.int32))
+    hd = histogram_tiles(jnp.asarray(bins_d), stats, lid, sel, B,
+                         method="scatter")
+    td = histogram_tiles(ds_s.bins, stats, lid, sel, B, method="scatter")
+    rclip = jnp.minimum(ds_s.sp_rows, n - 1)
+    valid = ds_s.sp_rows < n
+    eq = lid[rclip][:, :, None] == sel[None, None, :]
+    slot = jnp.where(eq.any(-1), jnp.argmax(eq, -1), P).astype(jnp.int32)
+    st = jnp.where(valid[:, :, None], stats[rclip], 0)
+    colz = jnp.arange(f_sp, dtype=jnp.int32)[:, None]
+    idx = (slot * f_sp + colz) * B + ds_s.sp_bins.astype(jnp.int32)
+    flat = jnp.zeros(((P + 1) * f_sp * B, 3), jnp.float32)
+    flat = flat.at[idx.reshape(-1)].add(st.reshape(-1, 3))
+    sp_t = flat.reshape(P + 1, f_sp, B, 3)[:P]
+    totals = td[:, 0].sum(axis=1)
+    defm = (jnp.arange(B, dtype=jnp.int32)[None, :]
+            == ds_s.sp_default[:, None])
+    recon = (totals[:, None, :] - sp_t.sum(axis=2))[:, :, None, :]
+    sp_t = jnp.where(defm[None, :, :, None], recon, sp_t)
+    for i, c in enumerate(ds_s.sp_cols):
+        ref, got = np.asarray(hd[:, c]), np.asarray(sp_t[:, i])
+        np.testing.assert_array_equal(ref[..., 2], got[..., 2])  # counts
+        np.testing.assert_allclose(got[..., :2], ref[..., :2], atol=5e-4,
+                                   rtol=1e-5)
+
+
+def test_sparse_end_to_end_quality_parity(rng):
+    X, y = _sparse_frame(rng)
+    ds_d, b_dense = _fit(X, y, enable_sparse=False)
+    ds_s, b_sparse = _fit(X, y, enable_sparse=True)
+    assert not ds_d.has_sparse_cols
+    assert ds_s.has_sparse_cols
+    # the concentrated columns left the dense matrix
+    assert ds_s.bins.shape[1] == ds_d.bins.shape[1] - len(ds_s.sp_cols)
+    a_d, a_s = _acc(b_dense, X, y), _acc(b_sparse, X, y)
+    assert a_s > 0.9 and abs(a_s - a_d) < 0.02, (a_s, a_d)
+    # the sparse columns actually split (their streams carry the signal)
+    imp = b_sparse._boosting.feature_importance("split")
+    assert imp[4] > 0
+    # model round-trips through text
+    b2 = lgb.Booster(model_str=b_sparse.model_to_string())
+    np.testing.assert_allclose(b2.predict(X[:64]), b_sparse.predict(X[:64]),
+                               rtol=1e-6)
+
+
+def test_sparse_parity_with_bagging_and_categorical(rng):
+    X, y = _sparse_frame(rng, sparse_f=2)
+    # a concentrated CATEGORICAL column (mode category >= 90%)
+    cat = np.where(rng.uniform(size=len(X)) < 0.93, 0.0,
+                   rng.randint(1, 5, size=len(X)).astype(np.float64))
+    X = np.column_stack([X, cat])
+    extra = {"categorical_feature": [X.shape[1] - 1],
+             # mask-path bagging (fraction > 0.5 keeps the subset copy off)
+             "bagging_fraction": 0.8, "bagging_freq": 1, "bagging_seed": 7}
+
+    def fit(enable):
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "enable_sparse": enable,
+                  "enable_bundle": False, "histogram_method": "scatter",
+                  "verbosity": -1, **extra}
+        ds = lgb.Dataset(X, label=y, params=params,
+                         categorical_feature=[X.shape[1] - 1])
+        return ds, lgb.train(params, ds, 6)
+
+    ds_s, b_s = fit(True)
+    ds_d, b_d = fit(False)
+    assert ds_s.has_sparse_cols
+    a_s, a_d = _acc(b_s, X, y), _acc(b_d, X, y)
+    assert a_s > 0.85 and abs(a_s - a_d) < 0.03, (a_s, a_d)
+
+
+def test_sparse_subset_copy_stays_off(rng):
+    """bagging_fraction <= 0.5 normally takes the subset-copy path; sparse
+    streams index ORIGINAL rows, so the mask path must be forced — and the
+    model still trains healthy."""
+    X, y = _sparse_frame(rng)
+    extra = {"bagging_fraction": 0.4, "bagging_freq": 1}
+    ds_s, b_s = _fit(X, y, True, extra)
+    assert ds_s.has_sparse_cols
+    assert b_s._boosting._bag_sub is None      # mask path forced
+    assert _acc(b_s, X, y) > 0.8
+
+
+def test_sparse_gates(rng):
+    X, y = _sparse_frame(rng)
+    # parallel learner requested at Dataset construct time -> no extraction
+    params = {"objective": "binary", "tree_learner": "data",
+              "enable_sparse": True, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    assert not ds.has_sparse_cols
+    # tiny data -> no extraction (path-flip guard for small tests)
+    Xs, ys = _sparse_frame(rng, n=300)
+    ds2 = lgb.Dataset(Xs, label=ys, params={"enable_sparse": True,
+                                            "verbosity": -1})
+    ds2.construct()
+    assert not ds2.has_sparse_cols
+    # rollback is gated with a clean error
+    from lightgbm_tpu.utils.log import LightGBMError
+    ds3, b3 = _fit(X, y, True)
+    with pytest.raises(LightGBMError):
+        b3._boosting.rollback_one_iter()
+
+
+def test_sparse_all_columns_sparse(rng):
+    """Every device column sparse: the dense matrix is [N, 0] and per-leaf
+    totals come from the direct per-slot reduction."""
+    n = 1500
+    X = np.zeros((n, 3))
+    for j in range(3):
+        nz = rng.choice(n, 60, replace=False)
+        X[nz, j] = rng.normal(size=60) + 1.0 + j
+    y = (X[:, 0] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+              "enable_sparse": True, "enable_bundle": False,
+              "histogram_method": "scatter", "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    b = lgb.train(params, ds, 5)
+    assert ds.has_sparse_cols and ds.bins.shape[1] == 0
+    params_d = {**params, "enable_sparse": False}
+    ds_d = lgb.Dataset(X, label=y, params=params_d)
+    b_d = lgb.train(params_d, ds_d, 5)
+    assert abs(_acc(b, X, y) - _acc(b_d, X, y)) < 0.02
+    assert _acc(b, X, y) > 0.95
