@@ -735,16 +735,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     def _zero_best_direct() -> SplitInfo:
         """All -inf placeholder without materializing a [L, F, B, 3] zeros
-        histogram (which is exactly what blocked mode must avoid)."""
+        histogram (which is exactly what blocked mode must avoid). Sum and
+        output fields carry ``hist_dtype`` so the while_loop state matches
+        what split_phase's find_best_splits returns (f64 under hist_dp)."""
         zi = jnp.zeros((L,), jnp.int32)
-        zf32 = jnp.zeros((L,), jnp.float32)
+        zs = jnp.zeros((L,), hist_dtype)
         return SplitInfo(
             gain=jnp.full((L,), NEG_INF, jnp.float32),
             feature=zi, threshold=zi,
             default_left=jnp.zeros((L,), bool),
-            left_sum_g=zf32, left_sum_h=zf32, left_count=zf32,
-            right_sum_g=zf32, right_sum_h=zf32, right_count=zf32,
-            left_output=zf32, right_output=zf32,
+            left_sum_g=zs, left_sum_h=zs, left_count=zs,
+            right_sum_g=zs, right_sum_h=zs, right_count=zs,
+            left_output=zs, right_output=zs,
             is_cat=jnp.zeros((L,), bool),
             cat_bitset=jnp.zeros((L, cat_words), jnp.uint32),
             seg_lo=jnp.full((L,), -1, jnp.int32),
@@ -752,16 +754,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
     def init_state() -> GrowState:
         zf = functools.partial(jnp.zeros, dtype=hist_dtype)
-        if blocked:
-            zero_best = _zero_best_direct()
-        else:
-            zero_best = find_best_splits(  # shape-consistent placeholder
-                zf((L, f_loc, num_bins, 3)),
-                zf((L,)), zf((L,)), zf((L,)), zf((L,)),
-                jnp.zeros((L,), jnp.int32), meta_s, params,
-                jnp.zeros((f_loc,), jnp.float32),
-                max_depth, with_categorical=False, cat_words=cat_words,
-                bundle=bundle_s)
+        # the placeholder best is never read before the first split phase
+        # replaces it wholesale (gain_eff also masks on hist_valid, all
+        # False here); building it directly instead of running
+        # find_best_splits over a constant zero histogram avoids multi-
+        # second XLA constant folds of the whole split search at compile
+        # time (observed: 6+ s per folded reduce-window in the r4 logs)
+        zero_best = _zero_best_direct()
         if cegb_state is not None:
             used_split = cegb_state.used_split
             row_used = cegb_state.row_used

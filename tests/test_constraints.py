@@ -9,6 +9,8 @@ col_sampler.hpp, cost_effective_gradient_boosting.hpp)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import lightgbm_tpu as lgb
 
 

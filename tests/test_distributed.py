@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from lightgbm_tpu.parallel.data_parallel import grow_tree_dp, make_mesh
 from lightgbm_tpu.models.grower import grow_tree
 

@@ -6,6 +6,8 @@ switches to feature-blocked passes that keep only per-leaf SplitInfo."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import lightgbm_tpu as lgb
 
 

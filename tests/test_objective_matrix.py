@@ -8,6 +8,8 @@ xentropy_objective.hpp)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import lightgbm_tpu as lgb
 
 
