@@ -110,15 +110,12 @@ def test_training_quality_parity(ref_model, binary_example):
     (Bit-identical trees are NOT expected — float accumulation order and
     histogram precision differ, the same tolerance the reference accepts
     between its own CPU and GPU paths, docs/GPU-Performance.rst:133-140.)"""
-    from scipy.stats import rankdata
+    from sklearn.metrics import roc_auc_score
     _, _, ref_pred = ref_model
     Xtr, ytr, Xte, yte = binary_example
 
     def auc(score):
-        npos = yte.sum()
-        nneg = len(yte) - npos
-        r = rankdata(score, method="average")
-        return (r[yte > 0].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+        return roc_auc_score(yte, score)
 
     params = {"objective": "binary", "num_leaves": 31,
               "learning_rate": 0.1, "min_data_in_leaf": 20,
@@ -127,3 +124,44 @@ def test_training_quality_parity(ref_model, binary_example):
     a_ref, a_ours = auc(ref_pred), auc(booster.predict(Xte))
     assert abs(a_ref - a_ours) < 0.01, (a_ref, a_ours)
     assert a_ours > 0.75
+
+
+@pytest.mark.parametrize("task", [
+    # (example dir, train file, test file, extra params)
+    ("regression", "regression.train", "regression.test",
+     {"objective": "regression", "metric": "l2"}),
+    ("multiclass_classification", "multiclass.train", "multiclass.test",
+     {"objective": "multiclass", "num_class": 5}),
+    ("lambdarank", "rank.train", "rank.test",
+     {"objective": "lambdarank", "metric": "ndcg"}),
+], ids=["regression", "multiclass", "lambdarank"])
+def test_cross_load_parity_all_objectives(task, tmp_path):
+    """Reference-trained models for the OTHER objective families load here
+    with prediction parity — regression, multiclass softmax (5 classes,
+    K trees/iter) and lambdarank (query files, LibSVM input)."""
+    exdir, train, test, extra = task
+    base = f"/root/reference/examples/{exdir}"
+    args = [f"data={base}/{train}", "num_trees=15", "num_leaves=15",
+            "min_data_in_leaf=20", "verbosity=-1",
+            f"output_model={tmp_path}/model.txt"]
+    args += [f"{k}={v}" for k, v in extra.items()]
+    _run_ref(tmp_path, "task=train", *args)
+    _run_ref(tmp_path, "task=predict", f"data={base}/{test}",
+             f"input_model={tmp_path}/model.txt",
+             f"output_result={tmp_path}/pred.txt")
+    ref_pred = np.loadtxt(tmp_path / "pred.txt")
+
+    booster = lgb.Booster(model_file=str(tmp_path / "model.txt"))
+    # the test files are LibSVM/TSV with a label column; parse like the
+    # reference's Predictor (sparse LibSVM for lambdarank)
+    if exdir == "lambdarank":
+        from sklearn.datasets import load_svmlight_file
+        # the reference reads LibSVM indices literally as 0-based columns
+        # (parser.cpp); sklearn's auto-detection would shift them by one
+        X, _ = load_svmlight_file(f"{base}/{test}", zero_based=True,
+                                  n_features=booster.num_feature())
+        X = np.asarray(X.todense())
+    else:
+        X = np.loadtxt(f"{base}/{test}")[:, 1:]
+    ours = booster.predict(X, raw_score=exdir == "lambdarank")
+    np.testing.assert_allclose(ours, ref_pred, rtol=1e-4, atol=1e-6)
