@@ -140,6 +140,7 @@ class GBDT:
         # (reference: gbdt.h num_init_iteration_, engine.py:163-169)
         self.loaded = None
         self.loaded_iters = 0
+        self._fused_cache: Dict[str, object] = {}  # hist method -> jitted step
         self._mt_cache: Dict[int, object] = {}   # host-tree idx -> ModelTree
         self._valid_raw_cache: Dict[int, jax.Array] = {}
         self._stacked_cache: Optional[Tuple[int, TreeArrays]] = None
@@ -486,6 +487,8 @@ class GBDT:
         """Apply updated parameters mid-training (reference: GBDT::ResetConfig,
         gbdt.cpp; used by the reset_parameter callback / learning_rates)."""
         self.config = config
+        # static grow options may have changed; the fused step re-traces
+        self._fused_cache = {}
         self.shrinkage_rate = config.learning_rate
         self.split_params = SplitParams.from_config(config)
         if self.train_set is not None:
@@ -581,6 +584,82 @@ class GBDT:
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
         return self.objective.get_grad_hess(self.train_score)
 
+    def _fused_ok(self, grad_external) -> bool:
+        """Whether this iteration can run gradients -> growth -> score
+        update as ONE jitted program (see _fused_step_fn). The gate mirrors
+        the serial fast path: per-class loops, host-side leaf renewal,
+        linear fitting, CEGB state, forced splits and the bagging subset
+        copy all interleave host work between the phases."""
+        cfg = self.config
+        return (type(self) is GBDT
+                and grad_external is None
+                and self.num_tree_per_iteration == 1
+                and self._parallel_grower is None
+                and self.objective is not None
+                and not self.objective.need_renew_tree_output
+                and getattr(self.objective, "jit_safe_gradients", True)
+                and not cfg.linear_tree
+                and self._cegb_mode == "off"
+                and not self._with_interactions
+                and not self._use_bynode
+                and self._forced_splits is None
+                and self._bag_sub is None
+                and not getattr(self, "_pre_part", False)
+                # 0-feature datasets take _grow_one's constant-tree path
+                and (self.train_set.bins.shape[1] > 0
+                     or getattr(self.train_set, "has_sparse_cols", False)))
+
+    def _fused_step_fn(self, hm: str):
+        """One jitted program per boosting iteration for the serial fast
+        path: objective gradients -> tree growth -> shrunk score delta,
+        fused so the host dispatches ONCE per iteration (three dispatches
+        otherwise — each a transport round trip through a TPU tunnel) and
+        XLA fuses the elementwise gradient math into the grower's first
+        histogram pass instead of materializing grad/hess through HBM.
+        The reference's TrainOneIter phases (gbdt.cpp:369-452) collapse
+        into one program; the TREE is returned unshrunk and finalize
+        applies the learning rate exactly as in the unfused path."""
+        step = self._fused_cache.get(hm)
+        if step is not None:
+            return step
+        cfg = self.config
+        ts = self.train_set
+        obj = self.objective
+        from .tree import leaf_values_of_rows
+        has_sp = getattr(ts, "has_sparse_cols", False)
+        grow_kw = dict(
+            max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
+            max_depth=cfg.max_depth, hist_method=hm,
+            tile_leaves=cfg.tile_leaves, hist_block=cfg.hist_block,
+            feature_block=self._feature_block(hm),
+            exact=cfg.tree_growth_mode == "exact",
+            with_categorical=ts.has_categorical,
+            with_monotone=self._with_monotone,
+            mono_mode=self._mono_mode,
+            mono_features=self._mono_features,
+            extra_trees=cfg.extra_trees,
+            hist_dp=self._hist_dp,
+            sp_cols=tuple(int(c) for c in ts.sp_cols) if has_sp else ())
+
+        def step(score, bins, binsT, mask, fmask, sparams, iter_key, lr,
+                 sp_rows, sp_bins, sp_default):
+            g, h = obj.get_grad_hess(score)
+            tree, leaf_id, _aux = grow_tree(
+                bins, g, h, mask, ts.feature_meta, sparams, fmask,
+                ts.missing_bin, binsT=binsT, rng_key=iter_key,
+                bundle_meta=ts.bundle_meta, sp_rows=sp_rows,
+                sp_bins=sp_bins, sp_default=sp_default, **grow_kw)
+            # the score ADD happens eagerly in the caller: fused into this
+            # program XLA emits score + delta as an FMA, whose single
+            # rounding drifts 1 ulp from the unfused path and breaks the
+            # bit-parity the serial-vs-parallel tests assert
+            delta = leaf_values_of_rows(tree.leaf_value, leaf_id) * lr
+            return tree, leaf_id, delta
+
+        step = jax.jit(step)
+        self._fused_cache[hm] = step
+        return step
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (gbdt.cpp:369-452). Returns True when the
@@ -589,14 +668,16 @@ class GBDT:
         cfg = self.config
         ts = self.train_set
         k = self.num_tree_per_iteration
+        self._update_bagging()
+        mask = self._bag_mask
+        if self._fused_ok(grad):
+            return self._train_one_iter_fused(mask)
         with profiling.timer("gradients"):
             if grad is None:
                 g, h = self._gradients()
             else:
                 g = jnp.asarray(np.asarray(grad, dtype=np.float32).reshape(self._score_shape))
                 h = jnp.asarray(np.asarray(hess, dtype=np.float32).reshape(self._score_shape))
-        self._update_bagging()
-        mask = self._bag_mask
         sample_weights = self._sample_weights(g, h)
         if sample_weights is not None:
             # GOSS-style reweighting: grad/hess amplified, the 0/1 mask keeps
@@ -658,6 +739,43 @@ class GBDT:
         # trees, prediction-identical to stopping on time
         self._flush_pending(only_ready=True)
         return no_split or self._lagged_stop
+
+    def _train_one_iter_fused(self, mask: jax.Array) -> bool:
+        """Single-dispatch iteration (see _fused_step_fn); everything after
+        the step call mirrors the unfused path's finalize/add/bias flow."""
+        from ..utils import profiling
+        ts = self.train_set
+        hm = self._hist_method()
+        has_sp = getattr(ts, "has_sparse_cols", False)
+        fmask = self._feature_mask()
+        iter_key = jax.random.fold_in(self._extra_rng_key, self.iter)
+        step = self._fused_step_fn(hm)
+        with profiling.timer_sync("grow_tree") as grow_scope:
+            tree, leaf_id, delta = step(
+                self.train_score, ts.bins,
+                ts.bins_T if self._use_binsT(hm) else None,
+                mask, fmask, self.split_params, iter_key,
+                jnp.float32(self.shrinkage_rate),
+                ts.sp_rows if has_sp else None,
+                ts.sp_bins if has_sp else None,
+                ts.sp_default if has_sp else None)
+            grow_scope.sync(tree.num_leaves)
+        new_score = self.train_score + delta
+        lazy = self._lazy_host_ok()
+        with profiling.timer("finalize_tree"):
+            if lazy:
+                tree = _shrink_tree(tree, self.shrinkage_rate)
+                t_host, had_split = None, True
+            else:
+                tree, t_host, had_split = self._finalize_tree(tree, leaf_id,
+                                                              0)
+        with profiling.timer("score_update", sync=None):
+            self._add_tree(tree, leaf_id, 0, t_host=t_host, lazy=lazy,
+                           new_score=new_score)
+            self._bias_after_score(0, had_split)
+        self.iter += 1
+        self._flush_pending(only_ready=True)
+        return (not lazy and not had_split) or self._lagged_stop
 
     def _grow_one(self, gc: jax.Array, hc: jax.Array, mask: jax.Array,
                   fmask: jax.Array, iter_key: jax.Array, hm: str):
@@ -927,23 +1045,30 @@ class GBDT:
     def _add_tree(self, tree: TreeArrays, leaf_id: jax.Array, class_idx: int,
                   linear: Optional[dict] = None,
                   t_host: Optional[TreeArrays] = None,
-                  lazy: bool = False) -> None:
+                  lazy: bool = False,
+                  new_score: Optional[jax.Array] = None) -> None:
         """Score updates for train (via leaf ids — no traversal needed) and
         valid sets (tree traversal on their binned matrices). ``linear``
         carries a fitted linear-leaf model: per-row train deltas plus the
         const/coeff tables (reference: Tree::AddPredictionToScore linear
         branch, tree.h). ``t_host`` is the already-fetched numpy mirror;
-        with ``lazy`` the mirror is deferred (async copy, see host_trees)."""
+        with ``lazy`` the mirror is deferred (async copy, see host_trees);
+        ``new_score`` is the already-updated train score from the fused
+        step (the delta was computed inside the one-dispatch program)."""
         from .tree import leaf_values_of_rows
         lr = self.shrinkage_rate
-        if linear is not None:
-            delta = jnp.asarray(linear["train_delta"] * lr)
+        if new_score is not None:
+            self.train_score = new_score
         else:
-            delta = leaf_values_of_rows(tree.leaf_value, leaf_id)
-        if self.num_tree_per_iteration > 1:
-            self.train_score = self.train_score.at[:, class_idx].add(delta)
-        else:
-            self.train_score = self.train_score + delta
+            if linear is not None:
+                delta = jnp.asarray(linear["train_delta"] * lr)
+            else:
+                delta = leaf_values_of_rows(tree.leaf_value, leaf_id)
+            if self.num_tree_per_iteration > 1:
+                self.train_score = self.train_score.at[:, class_idx].add(
+                    delta)
+            else:
+                self.train_score = self.train_score + delta
         self.trees.append(tree)
         if lazy:
             for leaf in jax.tree_util.tree_leaves(tree):

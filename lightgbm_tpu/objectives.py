@@ -67,6 +67,10 @@ class ObjectiveFunction:
     num_model_per_iteration = 1
     is_constant_hessian = False
     need_renew_tree_output = False
+    # False when get_grad_hess has host-side state (e.g. a numpy RNG draw)
+    # that would freeze at trace time inside a jitted training step — such
+    # objectives must run the phase-by-phase path (gbdt._fused_ok)
+    jit_safe_gradients = True
 
     def __init__(self, config: Config):
         self.config = config

@@ -234,6 +234,10 @@ class RankXENDCG(RankingObjective):
     """reference: rank_objective.hpp:285 RankXENDCG (arxiv 1911.09798)."""
 
     name = "rank_xendcg"
+    # gamma is re-drawn from a HOST numpy RNG every GetGradients call
+    # (rank_objective.hpp re-samples per iteration); inside a jitted
+    # training step the draw would freeze at trace time
+    jit_safe_gradients = False
 
     def __init__(self, config: Config):
         super().__init__(config)
