@@ -487,8 +487,10 @@ class GBDT:
         """Apply updated parameters mid-training (reference: GBDT::ResetConfig,
         gbdt.cpp; used by the reset_parameter callback / learning_rates)."""
         self.config = config
-        # static grow options may have changed; the fused step re-traces
-        self._fused_cache = {}
+        # NOTE: the fused-step cache is keyed on the static grow options
+        # (see _fused_step_fn), so a reset that only touches dynamic
+        # scalars (learning_rates schedules via reset_parameter — lr and
+        # SplitParams are traced arguments) reuses the compiled program
         self.shrinkage_rate = config.learning_rate
         self.split_params = SplitParams.from_config(config)
         if self.train_set is not None:
@@ -609,25 +611,15 @@ class GBDT:
                 and (self.train_set.bins.shape[1] > 0
                      or getattr(self.train_set, "has_sparse_cols", False)))
 
-    def _fused_step_fn(self, hm: str):
-        """One jitted program per boosting iteration for the serial fast
-        path: objective gradients -> tree growth -> shrunk score delta,
-        fused so the host dispatches ONCE per iteration (three dispatches
-        otherwise — each a transport round trip through a TPU tunnel) and
-        XLA fuses the elementwise gradient math into the grower's first
-        histogram pass instead of materializing grad/hess through HBM.
-        The reference's TrainOneIter phases (gbdt.cpp:369-452) collapse
-        into one program; the TREE is returned unshrunk and finalize
-        applies the learning rate exactly as in the unfused path."""
-        step = self._fused_cache.get(hm)
-        if step is not None:
-            return step
+    def _serial_grow_statics(self, hm: str) -> dict:
+        """STATIC grow_tree options for the serial learner — the single
+        definition the unfused call site and the fused step share, so a
+        new option cannot silently diverge between the two paths (the
+        suite asserts their bit-parity)."""
         cfg = self.config
         ts = self.train_set
-        obj = self.objective
-        from .tree import leaf_values_of_rows
         has_sp = getattr(ts, "has_sparse_cols", False)
-        grow_kw = dict(
+        return dict(
             max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
             max_depth=cfg.max_depth, hist_method=hm,
             tile_leaves=cfg.tile_leaves, hist_block=cfg.hist_block,
@@ -640,6 +632,28 @@ class GBDT:
             extra_trees=cfg.extra_trees,
             hist_dp=self._hist_dp,
             sp_cols=tuple(int(c) for c in ts.sp_cols) if has_sp else ())
+
+    def _fused_step_fn(self, hm: str):
+        """One jitted program per boosting iteration for the serial fast
+        path: objective gradients -> tree growth -> shrunk score delta,
+        fused so the host dispatches ONCE per iteration (three dispatches
+        otherwise — each a transport round trip through a TPU tunnel) and
+        XLA fuses the elementwise gradient math into the grower's first
+        histogram pass instead of materializing grad/hess through HBM.
+        The reference's TrainOneIter phases (gbdt.cpp:369-452) collapse
+        into one program; the TREE is returned unshrunk and finalize
+        applies the learning rate exactly as in the unfused path.
+
+        Cached by the STATIC grow options (+ objective identity), so
+        dynamic-parameter resets (learning_rates schedules) never retrace."""
+        ts = self.train_set
+        obj = self.objective
+        grow_kw = self._serial_grow_statics(hm)
+        key = (id(obj),) + tuple(grow_kw[k] for k in sorted(grow_kw))
+        step = self._fused_cache.get(key)
+        if step is not None:
+            return step
+        from .tree import leaf_values_of_rows
 
         def step(score, bins, binsT, mask, fmask, sparams, iter_key, lr,
                  sp_rows, sp_bins, sp_default):
@@ -657,7 +671,7 @@ class GBDT:
             return tree, leaf_id, delta
 
         step = jax.jit(step)
-        self._fused_cache[hm] = step
+        self._fused_cache[key] = step
         return step
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
@@ -816,45 +830,30 @@ class GBDT:
                 extra_trees=cfg.extra_trees,
                 vote_top_k=cfg.top_k, hist_dp=self._hist_dp)
         sub = self._bag_sub
+        has_sp = getattr(ts, "has_sparse_cols", False)
         return grow_tree(
             ts.bins, gc, hc, mask,
             ts.feature_meta, self.split_params, fmask, ts.missing_bin,
-            max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
-            max_depth=cfg.max_depth, hist_method=hm,
-            tile_leaves=cfg.tile_leaves,
-            hist_block=cfg.hist_block,
-            feature_block=self._feature_block(hm),
             binsT=ts.bins_T if self._use_binsT(hm) else None,
             sub_idx=sub[0] if sub else None,
             sub_bins=sub[1] if sub else None,
             sub_binsT=sub[2] if sub else None,
-            exact=cfg.tree_growth_mode == "exact",
-            with_categorical=ts.has_categorical,
-            with_monotone=self._with_monotone,
-            mono_mode=self._mono_mode,
-            mono_features=self._mono_features,
             with_interactions=self._with_interactions,
             interaction_groups=self._interaction_groups,
             cegb_mode=self._cegb_mode,
             cegb_coupled=self._cegb_coupled,
             cegb_lazy_penalty=self._cegb_lazy,
             cegb_state=self._cegb_aux,
-            extra_trees=cfg.extra_trees,
             use_bynode=self._use_bynode,
             bynode_fraction=jnp.float32(cfg.feature_fraction_bynode)
             if self._use_bynode else None,
             rng_key=iter_key,
             bundle_meta=ts.bundle_meta,
             forced_splits=self._forced_splits,
-            hist_dp=self._hist_dp,
-            sp_cols=tuple(int(c) for c in ts.sp_cols)
-            if getattr(ts, "has_sparse_cols", False) else (),
-            sp_rows=ts.sp_rows if getattr(ts, "has_sparse_cols", False)
-            else None,
-            sp_bins=ts.sp_bins if getattr(ts, "has_sparse_cols", False)
-            else None,
-            sp_default=ts.sp_default
-            if getattr(ts, "has_sparse_cols", False) else None)
+            sp_rows=ts.sp_rows if has_sp else None,
+            sp_bins=ts.sp_bins if has_sp else None,
+            sp_default=ts.sp_default if has_sp else None,
+            **self._serial_grow_statics(hm))
 
     def _use_binsT(self, hm: str) -> bool:
         """The feature-major bins copy doubles the dominant array; above

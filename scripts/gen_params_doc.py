@@ -1,0 +1,115 @@
+"""Generate docs/Parameters.md from the Config dataclass + alias table.
+
+The analog of the reference's helpers/parameter_generator.py, which
+code-generates config_auto.cpp AND docs/Parameters.rst from config.h's
+structured comments (reference: SURVEY §2.1; helpers/parameter_generator.py).
+Here the dataclass IS the single source of truth: this script introspects
+fields, defaults and the alias table, and groups rows under the section
+comments in config.py. CI-style check: tests assert the committed file is
+current (python scripts/gen_params_doc.py --check).
+"""
+
+import argparse
+import dataclasses
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lightgbm_tpu.config import Config, PARAM_ALIASES  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "docs", "Parameters.md")
+
+
+def _sections():
+    """Field name -> section title, from the '# Section' comments that
+    precede field groups in the dataclass body."""
+    import inspect
+    src = inspect.getsource(Config)
+    section = "Core"
+    out = {}
+    for line in src.splitlines():
+        stripped = line.strip()
+        m = re.match(r"#\s+(.*)", stripped)
+        if m and ":" not in stripped:
+            section = m.group(1)
+            continue
+        fm = re.match(r"(\w+)\s*:\s*\S", stripped)
+        if fm and not stripped.startswith(("def ", "class ")):
+            out[fm.group(1)] = section
+    return out
+
+
+def _fmt_default(v):
+    if isinstance(v, str):
+        return f'"{v}"' if v else '""'
+    if isinstance(v, list):
+        return "[]" if not v else repr(v)
+    return repr(v)
+
+
+def generate() -> str:
+    aliases = {}
+    for alias, canonical in PARAM_ALIASES.items():
+        aliases.setdefault(canonical, []).append(alias)
+    sections = _sections()
+    rows_by_section = {}
+    for f in dataclasses.fields(Config):
+        default = (f.default if f.default is not dataclasses.MISSING
+                   else f.default_factory())
+        typ = getattr(f.type, "__name__", None) or str(f.type)
+        row = (f.name, str(typ).replace("typing.", ""),
+               _fmt_default(default),
+               ", ".join(sorted(aliases.get(f.name, []))) or "—")
+        rows_by_section.setdefault(sections.get(f.name, "Other"),
+                                   []).append(row)
+
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` by "
+        "`scripts/gen_params_doc.py` — do not edit by hand "
+        "(the analog of the reference's `helpers/parameter_generator.py` "
+        "-> `docs/Parameters.rst` pipeline). Defaults match the "
+        "reference's `config.h`. Aliases resolve through `PARAM_ALIASES` "
+        "exactly like the reference's `ParameterAlias` / "
+        "`_ConfigAliases` tables.",
+        "",
+    ]
+    for section, rows in rows_by_section.items():
+        lines += [f"## {section}", "",
+                  "| parameter | type | default | aliases |",
+                  "|---|---|---|---|"]
+        for name, typ, default, al in rows:
+            lines.append(f"| `{name}` | {typ} | `{default}` | {al} |")
+        lines.append("")
+    n = sum(len(r) for r in rows_by_section.values())
+    lines.append(f"*{n} parameters, "
+                 f"{len(PARAM_ALIASES)} aliases.*")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if docs/Parameters.md is stale")
+    args = ap.parse_args()
+    text = generate()
+    if args.check:
+        with open(OUT) as fh:
+            if fh.read() != text:
+                print("docs/Parameters.md is stale; re-run "
+                      "scripts/gen_params_doc.py", file=sys.stderr)
+                sys.exit(1)
+        print("docs/Parameters.md is current")
+        return
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as fh:
+        fh.write(text)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""The generated parameter docs must stay current (the reference's CI
+checks config_auto.cpp / Parameters.rst are regenerated; SURVEY §2.1
+helpers/parameter_generator.py)."""
+
+import subprocess
+import sys
+
+
+def test_parameters_md_is_current():
+    r = subprocess.run(
+        [sys.executable, "scripts/gen_params_doc.py", "--check"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
