@@ -134,7 +134,9 @@ def test_training_quality_parity(ref_model, binary_example):
      {"objective": "multiclass", "num_class": 5}),
     ("lambdarank", "rank.train", "rank.test",
      {"objective": "lambdarank", "metric": "ndcg"}),
-], ids=["regression", "multiclass", "lambdarank"])
+    ("xendcg", "rank.train", "rank.test",
+     {"objective": "rank_xendcg", "metric": "ndcg"}),
+], ids=["regression", "multiclass", "lambdarank", "xendcg"])
 def test_cross_load_parity_all_objectives(task, tmp_path):
     """Reference-trained models for the OTHER objective families load here
     with prediction parity — regression, multiclass softmax (5 classes,
@@ -154,7 +156,7 @@ def test_cross_load_parity_all_objectives(task, tmp_path):
     booster = lgb.Booster(model_file=str(tmp_path / "model.txt"))
     # the test files are LibSVM/TSV with a label column; parse like the
     # reference's Predictor (sparse LibSVM for lambdarank)
-    if exdir == "lambdarank":
+    if exdir in ("lambdarank", "xendcg"):
         from sklearn.datasets import load_svmlight_file
         # the reference reads LibSVM indices literally as 0-based columns
         # (parser.cpp); sklearn's auto-detection would shift them by one
@@ -163,5 +165,5 @@ def test_cross_load_parity_all_objectives(task, tmp_path):
         X = np.asarray(X.todense())
     else:
         X = np.loadtxt(f"{base}/{test}")[:, 1:]
-    ours = booster.predict(X, raw_score=exdir == "lambdarank")
+    ours = booster.predict(X, raw_score=exdir in ("lambdarank", "xendcg"))
     np.testing.assert_allclose(ours, ref_pred, rtol=1e-4, atol=1e-6)
