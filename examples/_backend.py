@@ -1,0 +1,13 @@
+"""Shared backend selection for the example scripts.
+
+Honor JAX_PLATFORMS explicitly: some environments (e.g. a TPU-tunnel
+sitecustomize) override jax's backend selection, and a dead tunnel then
+stalls interpreter startup for minutes; this restores standard env-var
+behavior. A no-op everywhere else.
+"""
+import os
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

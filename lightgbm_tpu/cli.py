@@ -483,6 +483,12 @@ def run_save_binary(config: Config, params: Dict[str, str]) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # honor JAX_PLATFORMS explicitly: some environments (e.g. a TPU-tunnel
+    # sitecustomize) override jax's backend selection, and a dead tunnel
+    # then stalls CLI startup for minutes retrying; a no-op elsewhere
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     argv = argv if argv is not None else sys.argv[1:]
     params = _parse_argv(argv)
     config = Config.from_params(dict(params))

@@ -1,0 +1,24 @@
+"""Every example script must run end to end (the reference keeps
+examples/python-guide runnable the same way)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+SCRIPTS = sorted(f for f in os.listdir(EXAMPLES) if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(EXAMPLES) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, os.path.join(EXAMPLES, script)],
+                       env=env, capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, f"{script}:\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
