@@ -181,6 +181,113 @@ class Dataset:
         self.construct()
         return self._feature_names
 
+    # ------------------------------------------ reference API completeness
+    def get_feature_name(self) -> List[str]:
+        """reference: basic.py Dataset.get_feature_name."""
+        return self.get_feature_names()
+
+    def get_data(self):
+        """Raw data if still held (reference: Dataset.get_data; raises the
+        same way once free_raw_data has dropped it)."""
+        if self._constructed and self.data is None:
+            log.fatal("Cannot call get_data after freeing raw data, "
+                      "set free_raw_data=False when constructing the Dataset")
+        return self.data
+
+    def get_init_score(self) -> Optional[np.ndarray]:
+        return None if self.init_score is None else np.asarray(
+            self.init_score, dtype=np.float64)
+
+    def get_params(self) -> dict:
+        """reference: Dataset.get_params (the dataset-relevant params)."""
+        return dict(self.params)
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """The chain of reference datasets (reference: Dataset.get_ref_chain)."""
+        chain, seen = [], set()
+        cur = self
+        while cur is not None and id(cur) not in seen \
+                and len(chain) < ref_limit:
+            chain.append(cur)
+            seen.add(id(cur))
+            cur = cur.reference
+        return chain
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        """reference: Dataset.set_feature_name (pre-construct)."""
+        if self._constructed:
+            log.fatal("set_feature_name after construct is not supported")
+        self.feature_name = list(feature_name)
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """reference: Dataset.set_categorical_feature (pre-construct)."""
+        if self._constructed:
+            log.fatal("set_categorical_feature after construct is not "
+                      "supported; pass it to the Dataset constructor")
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """reference: Dataset.set_reference (align to a train set's
+        binning; pre-construct)."""
+        if self._constructed:
+            log.fatal("set_reference after construct is not supported")
+        self.reference = reference
+        return self
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Serialize to the .bin snapshot format the CLI's save_binary task
+        writes (reference: Dataset.save_binary -> SaveBinaryFile; loadable
+        with data=<file>.bin / lgb.Dataset(path))."""
+        if self.data is None:
+            log.fatal("save_binary needs the raw data (free_raw_data=False)")
+        if _is_scipy_sparse(self.data):
+            # the .bin format stores dense float arrays (cli._save_binary /
+            # np.load with allow_pickle=False); a pickled sparse object
+            # would save fine and then fail to load
+            log.fatal("save_binary does not support scipy-sparse data")
+        if self.label is None:
+            log.fatal("save_binary needs a label")
+        from .cli import _save_binary
+        X = _to_2d_float(self._pandas_to_codes(self.data))
+        _save_binary(filename, X, self.get_label(), self.get_weight(),
+                     self.get_group(), self.get_init_score())
+        return self
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Column-wise merge of another dataset's features (reference:
+        Dataset.add_features_from). Both must still hold raw data; the
+        merged dataset re-bins from scratch."""
+        if self.data is None or other.data is None:
+            log.fatal("add_features_from needs raw data on both datasets "
+                      "(free_raw_data=False)")
+        a = _to_2d_float(self._pandas_to_codes(self.data))
+        b = _to_2d_float(other._pandas_to_codes(other.data))
+        if a.shape[0] != b.shape[0]:
+            log.fatal("add_features_from: row counts differ "
+                      f"({a.shape[0]} vs {b.shape[0]})")
+        self.data = np.column_stack([a, b])
+        if self.feature_name not in ("auto", None) \
+                and other.feature_name not in ("auto", None):
+            self.feature_name = list(self.feature_name) + \
+                list(other.feature_name)
+        else:
+            self.feature_name = "auto"
+        # merge categorical designations (other's indices shift by our
+        # original width); name-based entries carry over as-is
+        def _cats(ds, offset):
+            cf = ds.categorical_feature
+            if cf in ("auto", None):
+                return []
+            return [c if isinstance(c, str) else int(c) + offset
+                    for c in cf]
+        merged = _cats(self, 0) + _cats(other, a.shape[1])
+        if merged:
+            self.categorical_feature = merged
+        self._constructed = False
+        return self
+
     # --------------------------------------------------------- construct
     def _resolve_categorical(self, num_features: int,
                              names: List[str]) -> List[int]:

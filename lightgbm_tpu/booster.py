@@ -91,6 +91,15 @@ class Booster:
     def eval_set(self, feval=None):
         return self._boosting.eval_set(feval)
 
+    def eval(self, data, name: str, feval=None):
+        """Evaluate the configured metrics on an arbitrary train-aligned
+        Dataset (reference: basic.py Booster.eval / GBDT valid metric
+        flow). Returns (name, metric, value, bigger_is_better) tuples."""
+        import numpy as np
+        b = self._boosting
+        score = np.asarray(b.score_dataset(data), dtype=np.float64)
+        return b.eval_metrics(score, data, name, feval)
+
     def eval_train(self, feval=None):
         old = self.config.is_provide_training_metric
         self.config.is_provide_training_metric = True
@@ -164,6 +173,198 @@ class Booster:
         if ts is not None:
             return ts.num_total_features
         return b.max_feature_idx + 1
+
+    # ----------------------------------------------- misc reference API
+    def attr(self, key: str):
+        """Runtime attribute (reference: basic.py Booster.attr/set_attr —
+        a key/value store on the booster object)."""
+        return getattr(self, "_attr", {}).get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        store = getattr(self, "_attr", None)
+        if store is None:
+            store = self._attr = {}
+        for k, v in kwargs.items():
+            if v is None:
+                store.pop(k, None)
+            else:
+                store[k] = str(v)
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """reference: basic.py Booster.set_train_data_name."""
+        self._train_data_name = name
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """Release the training data (reference: Booster.free_dataset —
+        prediction and model IO keep working; further training does not).
+        The binning metadata (mappers, bundles, missing routing) stays so
+        new data can still be binned for prediction; the O(N) arrays go."""
+        b = self._boosting
+        b._flush_pending()
+        ts = getattr(b, "train_set", None)
+        if ts is not None:
+            ts.bins = None
+            ts._bins_T = None
+            ts.sp_rows = ts.sp_bins = None
+            ts.label = ts.weight = ts.init_score = None
+            ts.raw_data_np = None
+        b.train_score = None
+        # valid sets hold the other O(N) device arrays (bins, per-row
+        # scores, raw caches) — the reference frees its datasets wholesale
+        for vs in b.valid_sets:
+            vs.bins = None
+            vs._bins_T = None
+            vs.raw_data_np = None
+        b.valid_sets = []
+        b.valid_names = []
+        b._valid_scores = []
+        b._valid_raw_cache = {}
+        self._train_set = None
+        return self
+
+    def free_network(self) -> "Booster":
+        """reference: Booster.free_network (tears down the comm layer)."""
+        from . import distributed
+        distributed.shutdown()
+        return self
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """reference: Booster.set_network -> Network::Init; here the
+        machine list feeds jax.distributed via distributed.init."""
+        from . import distributed
+        if isinstance(machines, (list, tuple)):
+            machines = ",".join(str(m) for m in machines)
+        distributed.init(machines=machines, num_machines=num_machines or None)
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """reference: Booster.get_leaf_output (Tree::LeafOutput)."""
+        ht = self._boosting.host_trees[tree_id]
+        return float(ht.leaf_value[leaf_id])
+
+    def lower_bound(self) -> float:
+        """Minimum possible raw score (reference: Booster.lower_bound ->
+        GBDT sum of per-tree minima, tree.cpp:316 per-tree bounds)."""
+        import numpy as np
+        return float(sum(float(np.min(ht.leaf_value))
+                         for ht in self._boosting.host_trees))
+
+    def upper_bound(self) -> float:
+        """Maximum possible raw score (reference: Booster.upper_bound)."""
+        import numpy as np
+        return float(sum(float(np.max(ht.leaf_value))
+                         for ht in self._boosting.host_trees))
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Randomly permute tree order in [start, end) iterations
+        (reference: Booster.shuffle_models -> GBDT::ShuffleModels; the
+        prediction SUM is order-independent, refit/early-stop sequences
+        are not)."""
+        import random
+        b = self._boosting
+        b._flush_pending()
+        k = b.num_tree_per_iteration
+        total = len(b.trees) // k
+        end = total if end_iteration <= 0 else min(end_iteration, total)
+        idx = list(range(start_iteration, end))
+        perm = idx[:]
+        random.shuffle(perm)
+        for attr in ("trees", "_host_trees", "tree_bias"):
+            arr = getattr(b, attr)
+            orig = list(arr)
+            for src, dst in zip(idx, perm):
+                for c in range(k):
+                    arr[dst * k + c] = orig[src * k + c]
+        b._mt_cache.clear()
+        b._stacked_cache = None
+        b._contrib_tree_cache = None
+        return self
+
+    def get_split_value_histogram(self, feature, bins=None):
+        """Histogram of a feature's split thresholds across the model
+        (reference: Booster.get_split_value_histogram). Returns
+        (counts, bin_edges) like np.histogram."""
+        import numpy as np
+        model = self.dump_model()
+        feature_names = model["feature_names"]
+        feat_idx = feature_names.index(feature) if isinstance(feature, str) \
+            else int(feature)
+        values = []
+
+        def walk(node):
+            if "split_feature" in node:
+                if node["split_feature"] == feat_idx \
+                        and node["decision_type"] == "<=":
+                    values.append(float(node["threshold"]))
+                walk(node["left_child"])
+                walk(node["right_child"])
+
+        for ti in model["tree_info"]:
+            walk(ti["tree_structure"])
+        if not values:
+            raise ValueError("feature was never used for splitting")
+        return np.histogram(values,
+                            bins=bins or max(10, len(set(values))))
+
+    def trees_to_dataframe(self):
+        """All nodes of all trees as one pandas DataFrame (reference:
+        basic.py Booster.trees_to_dataframe — same column names)."""
+        import pandas as pd
+        model = self.dump_model()
+        feature_names = model["feature_names"]
+        rows = []
+
+        def walk(tree_index, node, depth, parent):
+            # a splitless tree's dump is a bare {'leaf_value': ...} with no
+            # leaf_index (io/model_text.py single-leaf form)
+            node_idx = (f"{tree_index}-S{node['split_index']}"
+                        if "split_index" in node
+                        else f"{tree_index}-L{node.get('leaf_index', 0)}")
+            if "split_feature" in node:
+                rows.append({
+                    "tree_index": tree_index, "node_depth": depth,
+                    "node_index": node_idx,
+                    "left_child": None, "right_child": None,
+                    "parent_index": parent,
+                    "split_feature": feature_names[node["split_feature"]],
+                    "split_gain": node.get("split_gain"),
+                    "threshold": node.get("threshold"),
+                    "decision_type": node.get("decision_type"),
+                    "missing_direction":
+                        "left" if node.get("default_left") else "right",
+                    "missing_type": node.get("missing_type"),
+                    "value": node.get("internal_value"),
+                    "weight": node.get("internal_weight"),
+                    "count": node.get("internal_count")})
+                me = len(rows) - 1
+                lid = walk(tree_index, node["left_child"], depth + 1,
+                           node_idx)
+                rid = walk(tree_index, node["right_child"], depth + 1,
+                           node_idx)
+                rows[me]["left_child"] = lid
+                rows[me]["right_child"] = rid
+            else:
+                rows.append({
+                    "tree_index": tree_index, "node_depth": depth,
+                    "node_index": node_idx,
+                    "left_child": None, "right_child": None,
+                    "parent_index": parent,
+                    "split_feature": None, "split_gain": None,
+                    "threshold": None, "decision_type": None,
+                    "missing_direction": None, "missing_type": None,
+                    "value": node.get("leaf_value"),
+                    "weight": node.get("leaf_weight"),
+                    "count": node.get("leaf_count")})
+            return node_idx
+
+        for ti in model["tree_info"]:
+            walk(ti["tree_index"], ti["tree_structure"], 1, None)
+        return pd.DataFrame(rows)
 
     def model_from_string(self, model_str: str) -> "Booster":
         """Replace this booster's model with one parsed from text

@@ -1325,27 +1325,42 @@ class GBDT:
             sets.append((name, vs, score))
         for ds_name, ds, score in sets:
             score_np = np.asarray(score, dtype=np.float64)
-            for name in self.metric_names:
-                key = (name, id(ds))
-                mm = self._metric_cache.get(key)
+            out.extend(self.eval_metrics(score_np, ds, ds_name, feval,
+                                         cache=True))
+        return out
+
+    def eval_metrics(self, score_np, ds, ds_name, feval=None,
+                     cache: bool = False):
+        """Run every configured metric (+ optional feval) over raw scores
+        for one dataset — the single metric-reporting loop eval_set and
+        Booster.eval share. ``cache`` keeps the initialized Metric objects
+        keyed by dataset identity (safe for the booster's own long-lived
+        train/valid sets; arbitrary eval datasets skip it)."""
+        out = []
+        for name in self.metric_names:
+            key = (name, id(ds))
+            mm = self._metric_cache.get(key) if cache else None
+            if mm is None:
+                mm = create_metric(name, self.config)
                 if mm is None:
-                    mm = create_metric(name, self.config)
-                    if mm is None:
-                        continue
-                    mm.init(ds.get_label(), ds.get_weight(), ds.get_group())
+                    continue
+                mm.init(ds.get_label(), ds.get_weight(), ds.get_group())
+                if cache:
                     self._metric_cache[key] = mm
-                val = mm.eval(score_np, self.objective)
-                if isinstance(val, (list, tuple)):
-                    # multi-position metrics (ndcg@k / map@k) report one
-                    # entry per position (reference: rank_metric.hpp name_)
-                    names = mm.name if isinstance(mm.name, (list, tuple)) \
-                        else [mm.name] * len(val)
-                    for nm2, v2 in zip(names, val):
-                        out.append((ds_name, nm2, float(v2), mm.bigger_is_better))
-                else:
-                    out.append((ds_name, mm.name, val, mm.bigger_is_better))
-            if feval is not None:
-                out.extend(_call_feval(feval, score_np, ds, self.objective, ds_name))
+            val = mm.eval(score_np, self.objective)
+            if isinstance(val, (list, tuple)):
+                # multi-position metrics (ndcg@k / map@k) report one
+                # entry per position (reference: rank_metric.hpp name_)
+                names = mm.name if isinstance(mm.name, (list, tuple)) \
+                    else [mm.name] * len(val)
+                for nm2, v2 in zip(names, val):
+                    out.append((ds_name, nm2, float(v2),
+                                mm.bigger_is_better))
+            else:
+                out.append((ds_name, mm.name, val, mm.bigger_is_better))
+        if feval is not None:
+            out.extend(_call_feval(feval, score_np, ds, self.objective,
+                                   ds_name))
         return out
 
     # ---------------------------------------------------------- predict
@@ -1376,6 +1391,51 @@ class GBDT:
         stacked = stack_trees(self.trees[:n_trees])
         self._stacked_cache = (n_trees, stacked)
         return stacked
+
+    def score_dataset(self, ds) -> np.ndarray:
+        """Raw scores for a train-aligned Dataset via traversal of its
+        BINNED matrix (the mechanism Booster.eval uses for a dataset whose
+        raw features were freed — the reference scores added valid sets
+        through the same binned representation, score_updater.hpp)."""
+        from .tree import predict_values_stacked
+        ds.construct()
+        ts = self.train_set
+        if ts is not None and ds is not ts and ds.reference is not ts \
+                and ds.mappers is not ts.mappers:
+            # tree thresholds are TRAIN-bin indices; traversing a matrix
+            # binned with different mappers silently computes wrong scores
+            # (the reference rejects misaligned valid data the same way)
+            log.fatal("eval dataset was not binned against the training "
+                      "set; construct it with reference=<train Dataset>")
+        k = self.num_tree_per_iteration
+        n = ds.num_data
+        if self.loaded_iters > 0 or self.config.linear_tree:
+            # loaded host trees / linear leaves need raw features
+            raw = getattr(ds, "raw_data_np", None)
+            if raw is None and ds.data is not None:
+                from ..basic import _is_scipy_sparse, _to_2d_float
+                raw = ds.data if _is_scipy_sparse(ds.data) else \
+                    _to_2d_float(ds._pandas_to_codes(ds.data))
+            if raw is None:
+                log.fatal("eval with a loaded init_model or linear trees "
+                          "needs raw features (construct the Dataset with "
+                          "free_raw_data=False)")
+            return self.predict_raw(raw)
+        out = np.broadcast_to(
+            np.asarray(self.init_scores, np.float64), (n, k)).copy()
+        init = ds.init_score
+        if init is not None:
+            out = np.asarray(init, np.float64).reshape(n, k).copy()
+        stacked = self._stacked()
+        if stacked is not None:
+            vals = np.asarray(predict_values_stacked(
+                stacked, ds.bins, ds.missing_bin), np.float64)  # [T, n]
+            biases = np.asarray(self.tree_bias, np.float64)[:, None]
+            vals = vals - biases if len(self.tree_bias) == vals.shape[0] \
+                else vals
+            for t in range(vals.shape[0]):
+                out[:, t % k] += vals[t]
+        return out if k > 1 else out[:, 0]
 
     def predict_raw(self, X, num_iteration: Optional[int] = None,
                     start_iteration: int = 0,

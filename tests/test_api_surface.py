@@ -1,0 +1,165 @@
+"""Reference python-API surface completeness: the Booster/Dataset methods
+the reference ships beyond the core train/predict flow (reference:
+python-package/lightgbm/basic.py Booster/Dataset)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(600, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "metric": ["auc", "binary_logloss"],
+              "num_leaves": 15, "min_data_in_leaf": 5, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    booster = lgb.train(params, ds, 8)
+    return X, y, params, booster
+
+
+def test_booster_attr_roundtrip(small_model):
+    _, _, _, b = small_model
+    assert b.attr("note") is None
+    b.set_attr(note="hello", n=3)
+    assert b.attr("note") == "hello" and b.attr("n") == "3"
+    b.set_attr(note=None)
+    assert b.attr("note") is None
+
+
+def test_booster_bounds_and_leaf_output(small_model):
+    X, _, _, b = small_model
+    lo, hi = b.lower_bound(), b.upper_bound()
+    raw = b.predict(X, raw_score=True)
+    assert lo <= raw.min() and raw.max() <= hi
+    v = b.get_leaf_output(0, 0)
+    assert np.isfinite(v)
+
+
+def test_booster_eval_arbitrary_dataset(small_model):
+    X, y, params, b = small_model
+    rng = np.random.RandomState(9)
+    Xn = rng.normal(size=(300, 6))
+    yn = (Xn[:, 0] + 0.5 * Xn[:, 1] > 0).astype(np.float64)
+    ds = lgb.Dataset(Xn, label=yn, reference=b._train_set)
+    res = b.eval(ds, "newdata")
+    names = {r[1] for r in res}
+    assert "auc" in names and "binary_logloss" in names
+    auc = [r[2] for r in res if r[1] == "auc"][0]
+    # sanity vs direct computation
+    from sklearn.metrics import roc_auc_score
+    ref = roc_auc_score(yn, b.predict(Xn, raw_score=True))
+    assert abs(auc - ref) < 1e-6, (auc, ref)
+
+
+def test_booster_split_value_histogram_and_df(small_model):
+    _, _, _, b = small_model
+    counts, edges = b.get_split_value_histogram(0)
+    assert counts.sum() > 0 and len(edges) == len(counts) + 1
+    df = b.trees_to_dataframe()
+    assert set(["tree_index", "node_depth", "node_index", "split_feature",
+                "threshold", "value", "count"]).issubset(df.columns)
+    assert df["tree_index"].nunique() == b.num_trees()
+    # splits reference real feature names; leaves have values
+    assert df[df.split_feature.notna()].shape[0] > 0
+    # children resolve to existing node ids within the same tree
+    t0 = df[df.tree_index == 0]
+    ids = set(t0.node_index)
+    for c in t0[t0.left_child.notna()].left_child:
+        assert c in ids
+
+
+def test_booster_shuffle_models_preserves_predictions(small_model):
+    X, y, params, _ = small_model
+    ds = lgb.Dataset(X, label=y, params=params)
+    b = lgb.train(params, ds, 8)
+    before = b.predict(X[:64], raw_score=True)
+    b.shuffle_models()
+    np.testing.assert_allclose(b.predict(X[:64], raw_score=True), before,
+                               rtol=1e-6)
+
+
+def test_booster_free_dataset_keeps_predicting(small_model):
+    X, y, params, _ = small_model
+    ds = lgb.Dataset(X, label=y, params=params)
+    b = lgb.train(params, ds, 5)
+    before = b.predict(X[:16])
+    b.free_dataset()
+    np.testing.assert_array_equal(b.predict(X[:16]), before)
+
+
+def test_dataset_surface(small_model, tmp_path):
+    X, y, params, b = small_model
+    ds = b._train_set
+    assert ds.get_feature_name() == ds.get_feature_names()
+    assert ds.get_data() is not None
+    assert isinstance(ds.get_params(), dict)
+    assert ds.get_ref_chain() == [ds]
+    ds2 = lgb.Dataset(X[:100], label=y[:100])
+    ds2.set_feature_name([f"f{i}" for i in range(6)])
+    ds2.set_categorical_feature([5])
+    ds2.construct()
+    assert ds2.get_feature_names()[0] == "f0"
+    # save_binary round-trips through the CLI .bin loader
+    p = tmp_path / "snap.bin"
+    lgb.Dataset(X, label=y, free_raw_data=False).save_binary(str(p))
+    assert p.exists() and p.stat().st_size > 1000
+
+
+def test_dataset_add_features_from(small_model):
+    X, y, params, _ = small_model
+    d1 = lgb.Dataset(X[:, :3], label=y, free_raw_data=False)
+    d2 = lgb.Dataset(X[:, 3:], free_raw_data=False)
+    d1.add_features_from(d2)
+    d1.construct()
+    assert d1.num_feature() == 6
+    booster = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1}, d1, 5)
+    acc = np.mean((booster.predict(X) > 0.5) == (y > 0.5))
+    assert acc > 0.85
+
+
+def test_eval_rejects_misaligned_dataset(small_model):
+    """Tree thresholds are TRAIN-bin indices; a dataset binned with its
+    own mappers must be rejected, not silently mis-scored."""
+    X, y, params, b = small_model
+    from lightgbm_tpu.utils.log import LightGBMError
+    rogue = lgb.Dataset(X[:100], label=y[:100])   # no reference=
+    rogue.construct()
+    with pytest.raises(LightGBMError):
+        b.eval(rogue, "rogue")
+
+
+def test_trees_to_dataframe_splitless_tree(rng):
+    X = rng.normal(size=(600, 4))
+    y = (X[:, 0] > 0).astype(np.float64)
+    b = lgb.train({"objective": "binary", "min_gain_to_split": 1e9,
+                   "num_leaves": 7, "verbosity": -1},
+                  lgb.Dataset(X, label=y), 2)
+    df = b.trees_to_dataframe()
+    assert (df.node_depth == 1).all()            # all single-leaf roots
+    assert df.split_feature.isna().all()
+
+
+def test_save_binary_rejects_sparse(rng):
+    import scipy.sparse as sp
+    from lightgbm_tpu.utils.log import LightGBMError
+    X = sp.random(200, 5, density=0.2, format="csr", random_state=0)
+    ds = lgb.Dataset(X, label=np.zeros(200), free_raw_data=False)
+    with pytest.raises(LightGBMError):
+        ds.save_binary("/tmp/nope.bin")
+
+
+def test_add_features_from_merges_categoricals(rng):
+    X = rng.normal(size=(700, 4))
+    cat = rng.randint(0, 4, size=700).astype(np.float64)
+    y = ((cat == 1) | (X[:, 0] > 0.8)).astype(np.float64)
+    d1 = lgb.Dataset(X, label=y, free_raw_data=False)
+    d2 = lgb.Dataset(cat.reshape(-1, 1), categorical_feature=[0],
+                     free_raw_data=False)
+    d1.add_features_from(d2)
+    assert d1.categorical_feature == [4]          # shifted by d1's width
+    d1.construct()
+    assert d1.has_categorical
