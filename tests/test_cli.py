@@ -24,7 +24,11 @@ def workdir(tmp_path_factory):
     for f in ("binary.train", "binary.test"):
         src = os.path.join(EXAMPLES, "binary_classification", f)
         (d / f).write_bytes(open(src, "rb").read())
-    return d
+    # tests chdir into the workdir; restore so the leaked CWD cannot
+    # break later tests sharing the pytest process (relative paths)
+    orig = os.getcwd()
+    yield d
+    os.chdir(orig)
 
 
 def test_native_parser_matches_numpy():

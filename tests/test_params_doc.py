@@ -2,12 +2,18 @@
 checks config_auto.cpp / Parameters.rst are regenerated; SURVEY §2.1
 helpers/parameter_generator.py)."""
 
+import os
 import subprocess
 import sys
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def test_parameters_md_is_current():
+    # absolute path: another test in the same pytest process may have
+    # changed the working directory
     r = subprocess.run(
-        [sys.executable, "scripts/gen_params_doc.py", "--check"],
-        capture_output=True, text=True, timeout=120)
+        [sys.executable, os.path.join(REPO, "scripts", "gen_params_doc.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
     assert r.returncode == 0, r.stdout + r.stderr
