@@ -1,0 +1,67 @@
+"""Randomized-config robustness sweep: train -> save -> reload -> predict
+parity over sampled parameter combinations (the interaction-coverage
+complement to the per-feature matrix tests; seeds fixed, so failures
+reproduce)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.slow
+
+
+def _sample_params(rng):
+    p = {"objective": "binary", "verbosity": -1,
+         "num_leaves": int(rng.choice([4, 15, 31])),
+         "min_data_in_leaf": int(rng.choice([1, 5, 40])),
+         "learning_rate": float(rng.choice([0.05, 0.3])),
+         "max_depth": int(rng.choice([-1, 3, 6])),
+         "feature_fraction": float(rng.choice([1.0, 0.7])),
+         "max_bin": int(rng.choice([15, 63, 255]))}
+    if rng.rand() < 0.5:
+        p.update(bagging_fraction=float(rng.choice([0.4, 0.8])),
+                 bagging_freq=1)
+    if rng.rand() < 0.3:
+        p["extra_trees"] = True
+    if rng.rand() < 0.3:
+        p["min_gain_to_split"] = 0.1
+    if rng.rand() < 0.3:
+        p["lambda_l1"] = 0.5
+    if rng.rand() < 0.3:
+        p["lambda_l2"] = 5.0
+    if rng.rand() < 0.25:
+        p["monotone_constraints"] = [1, -1] + [0] * 6
+    return p
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_random_config_roundtrip(seed):
+    rng = np.random.RandomState(1000 + seed)
+    n = 800
+    X = rng.normal(size=(n, 8))
+    if rng.rand() < 0.4:    # concentrated column (sparse-storage path)
+        X[:, 5] = np.where(rng.uniform(size=n) < 0.93, 0.0,
+                           rng.normal(size=n))
+    if rng.rand() < 0.4:    # missing values
+        X[rng.uniform(size=X.shape) < 0.05] = np.nan
+    y = ((np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1])) > 0
+         ).astype(np.float64)
+    params = _sample_params(rng)
+    cats = [7] if rng.rand() < 0.4 else "auto"
+    if cats != "auto":
+        X[:, 7] = rng.randint(0, 5, size=n)
+    ds = lgb.Dataset(X, label=y, params=params, categorical_feature=cats)
+    booster = lgb.train(params, ds, 6)
+    pred = booster.predict(X[:200])
+    assert np.isfinite(pred).all(), params
+    # text round trip preserves predictions
+    clone = lgb.Booster(model_str=booster.model_to_string())
+    np.testing.assert_allclose(clone.predict(X[:200]), pred, rtol=1e-6,
+                               err_msg=str(params))
+    # and the model is at least directionally learning when it can split
+    first = booster.dump_model()["tree_info"][0]["num_leaves"] \
+        if booster.num_trees() else 0
+    if first > 1:
+        acc = np.mean((booster.predict(X) > 0.5) == (y > 0.5))
+        assert acc > 0.55, (acc, params)
