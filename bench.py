@@ -14,8 +14,15 @@ stdout: {"metric", "value", "unit", "vs_baseline", ...} where vs_baseline
 = reference_sec_per_iter / ours, scaled to the rows actually run (>1 means
 faster than the reference CPU baseline at that scale). The headline line
 prints as soon as the main run completes (insurance against a tunnel
-wedge during the secondary q8/bin63 probes) and again, enriched with the
-probe fields, at the end — parsers must take the LAST JSON line.
+wedge during the secondary probes) and again, enriched with the probe
+fields, at the end — parsers must take the LAST JSON line.
+
+The main run trains with the leaf-partitioned row-compaction ladder ON
+(the default) and reports its ``rows_streamed_per_tree`` /
+``compact_sec_per_iter``; a compaction-off probe at the same scale emits
+``nocompact_sec_per_iter`` + ``nocompact_rows_streamed_per_tree`` so the
+headroom (the DataPartition-analog row reduction) is on record on every
+backend. The q8 / max_bin=63 probes remain TPU-only.
 """
 
 import argparse
@@ -35,7 +42,7 @@ FULL_ROWS = 10_500_000
 PEAK_F32_FLOPS = 98e12
 
 
-def run_at_scale(rows, args, hist_method="auto"):
+def run_at_scale(rows, args, hist_method="auto", hist_compaction=True):
     import numpy as np
     import jax
     import lightgbm_tpu as lgb
@@ -83,6 +90,7 @@ def run_at_scale(rows, args, hist_method="auto"):
         "learning_rate": 0.1, "max_bin": args.max_bin,
         "min_data_in_leaf": 100, "min_sum_hessian_in_leaf": 100.0,
         "histogram_method": hist_method,
+        "hist_compaction": hist_compaction,
         "verbosity": -1,
     }, train_set=ds)
 
@@ -138,7 +146,12 @@ def run_at_scale(rows, args, hist_method="auto"):
                         / (npos * nneg))
         phases["valid_auc_predict"] = time.time() - t0
         mark(f"valid_auc_predict (auc={auc})")
-    return sec_per_iter, phases, auc, max(args.rounds, done)
+    # compaction telemetry: rows read by histogram passes per tree (the
+    # device-side accumulator syncs here, after the timed loop)
+    rows_per_tree = booster._boosting.rows_streamed_per_tree
+    mark(f"rows_streamed_per_tree={rows_per_tree:.0f} "
+         f"(compaction={'on' if hist_compaction else 'off'})")
+    return sec_per_iter, phases, auc, max(args.rounds, done), rows_per_tree
 
 
 def main():
@@ -200,7 +213,7 @@ def main():
     if args.no_ladder:
         ladder = [args.rows]
     sec_per_iter = phases = used_rows = auc = rounds_run = None
-    used_method = None
+    used_method = rows_per_tree = None
     # the method ladder guards against a kernel-specific failure: "auto"
     # (the fused Pallas fast path on TPU) falls back to the XLA onehot
     # contraction at the same scale before shrinking rows
@@ -208,8 +221,8 @@ def main():
         for hm in ("auto", "onehot"):
             try:
                 print(f"# trying rows={rows} hist={hm}", file=sys.stderr)
-                sec_per_iter, phases, auc, rounds_run = run_at_scale(
-                    rows, args, hist_method=hm)
+                sec_per_iter, phases, auc, rounds_run, rows_per_tree = \
+                    run_at_scale(rows, args, hist_method=hm)
                 used_rows = rows
                 used_method = hm
                 break
@@ -250,6 +263,12 @@ def main():
         "auc": round(auc, 6) if auc is not None else None,
         "auc_rounds": rounds_run,
         "hist_method": used_method,
+        # the main run has compaction ON (the default): these two fields
+        # are the compacted numbers; the nocompact_* probe below supplies
+        # the uncompacted side of the headroom comparison
+        "compact_sec_per_iter": round(sec_per_iter, 4),
+        "rows_streamed_per_tree": round(rows_per_tree, 1)
+        if rows_per_tree is not None else None,
         "phases": {k: round(v, 3) for k, v in phases.items()},
     }
     # insurance: print the headline line NOW — a later probe that wedges
@@ -266,6 +285,31 @@ def main():
             return False
         return True
 
+    # compaction on/off headroom probe (runs on ANY backend — the row
+    # reduction shows on the CPU scatter path too): same scale with
+    # hist_compaction=false supplies the uncompacted sec_per_iter and
+    # rows_streamed_per_tree the acceptance comparison needs
+    nc_sec = nc_rows = None
+    if probe_headroom("nocompact"):
+        try:
+            nc_sec, _, _, _, nc_rows = run_at_scale(
+                used_rows, args, hist_method=used_method,
+                hist_compaction=False)
+            print(f"# nocompact probe: {nc_sec:.3f} s/iter, "
+                  f"rows/tree={nc_rows:.0f} (compacted run: "
+                  f"{sec_per_iter:.3f} s/iter, {rows_per_tree:.0f})",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            print("# nocompact probe failed; omitting", file=sys.stderr)
+    result.update({
+        "nocompact_sec_per_iter": round(nc_sec, 4)
+        if nc_sec is not None else None,
+        "nocompact_rows_streamed_per_tree": round(nc_rows, 1)
+        if nc_rows is not None else None,
+    })
+    print(json.dumps(result), flush=True)
+
     # secondary probe: the opt-in int8 quantized-gradient mode, WITH its
     # own held-out AUC so quality-at-speed is on record (the promotion
     # gate for folding q8 into "auto" is AUC within ~0.001 of the default
@@ -275,7 +319,7 @@ def main():
     if (used_method == "auto" and jax.default_backend() == "tpu"
             and probe_headroom("q8")):
         try:
-            q8_sec, q8_ph, q8_auc, _ = run_at_scale(
+            q8_sec, q8_ph, q8_auc, _, _ = run_at_scale(
                 used_rows, args, hist_method="pallas_q8")
             print(f"# q8 probe: {q8_sec:.3f} s/iter, auc={q8_auc}",
                   file=sys.stderr)
@@ -295,7 +339,7 @@ def main():
             and args.max_bin != 63 and probe_headroom("bin63")):
         try:
             b63_args = argparse.Namespace(**{**vars(args), "max_bin": 63})
-            b63_sec, b63_ph, b63_auc, _ = run_at_scale(
+            b63_sec, b63_ph, b63_auc, _, _ = run_at_scale(
                 used_rows, b63_args, hist_method="auto")
             print(f"# max_bin=63: {b63_sec:.3f} s/iter, "
                   f"auc={b63_auc}", file=sys.stderr)
@@ -308,7 +352,7 @@ def main():
         # the projected fastest configuration, with its own AUC readout
         if probe_headroom("bin63+q8"):
             try:
-                b63q8_sec, _, b63q8_auc, _ = run_at_scale(
+                b63q8_sec, _, b63q8_auc, _, _ = run_at_scale(
                     used_rows, b63_args, hist_method="pallas_q8")
                 print(f"# max_bin=63 + q8: {b63q8_sec:.3f} s/iter, "
                       f"auc={b63q8_auc}", file=sys.stderr)

@@ -207,7 +207,11 @@ class Booster:
         if ts is not None:
             ts.bins = None
             ts._bins_T = None
-            ts.sp_rows = ts.sp_bins = None
+            # all four sparse-storage fields go together: leaving sp_cols
+            # set would keep has_sparse_cols reporting True on a dataset
+            # whose streams are gone (ADVICE r5 low)
+            ts.sp_rows = ts.sp_bins = ts.sp_cols = ts.sp_default = None
+            ts._traversal_bins_cache = None
             ts.label = ts.weight = ts.init_score = None
             ts.raw_data_np = None
         b.train_score = None
@@ -264,16 +268,22 @@ class Booster:
         """Randomly permute tree order in [start, end) iterations
         (reference: Booster.shuffle_models -> GBDT::ShuffleModels; the
         prediction SUM is order-independent, refit/early-stop sequences
-        are not)."""
+        are not). Deterministic like the reference's fixed-seed
+        ``Random tmp_rand(17)`` (gbdt.h:95): fresh boosters produce the
+        same order, and like the reference's MEMBER rng, successive calls
+        on one booster draw successive permutations rather than repeating
+        the first."""
         import random
         b = self._boosting
         b._flush_pending()
+        if not hasattr(b, "_shuffle_rand"):
+            b._shuffle_rand = random.Random(17)
         k = b.num_tree_per_iteration
         total = len(b.trees) // k
         end = total if end_iteration <= 0 else min(end_iteration, total)
         idx = list(range(start_iteration, end))
         perm = idx[:]
-        random.shuffle(perm)
+        b._shuffle_rand.shuffle(perm)
         for attr in ("trees", "_host_trees", "tree_bias"):
             arr = getattr(b, attr)
             orig = list(arr)

@@ -304,6 +304,17 @@ class Config:
     histogram_method: str = "auto"                  # auto|scatter|binloop|onehot|onehot_hilo|onehot_q8|pallas|pallas_hilo|pallas_q8
     tile_leaves: int = 0                            # hist tile width (0 = auto: 42)
     hist_block: int = 0                             # hist row-block size (0 = auto per method)
+    # histogram subtraction trick (serial_tree_learner.cpp:311-320): build
+    # only the smaller sibling and derive the larger as parent - smaller
+    hist_subtraction: bool = True
+    # leaf-partitioned row compaction (the DataPartition analog,
+    # data_partition.hpp:21-60): gather only the pending leaves' rows into
+    # a padded buffer before each histogram tile pass, sized by the first
+    # ladder rung that fits (fractions of the histogram row count; the
+    # full-size pass remains the fallback). Serial learner only.
+    hist_compaction: bool = True
+    hist_compaction_ladder: List[float] = field(
+        default_factory=lambda: [0.5, 0.125])
 
     def __post_init__(self):
         if self.seed is not None:
@@ -382,11 +393,12 @@ def _coerce(cfg: Config, key: str, value: Any) -> Any:
         return [list(map(int, grp)) for grp in value]
     if key in ("valid", "label_gain", "eval_at", "monotone_constraints", "feature_contri",
                "max_bin_by_feature", "auc_mu_weights", "cegb_penalty_feature_lazy",
-               "cegb_penalty_feature_coupled"):
+               "cegb_penalty_feature_coupled", "hist_compaction_ladder"):
         if isinstance(value, str):
             parts = [v for v in value.split(",") if v]
             elem = float if key in ("label_gain", "feature_contri", "auc_mu_weights",
-                                    "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled") else (
+                                    "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled",
+                                    "hist_compaction_ladder") else (
                 str if key == "valid" else int)
             return [elem(v) for v in parts]
         return list(value)

@@ -210,6 +210,8 @@ class GBDT:
                          and self.objective.need_renew_tree_output))
 
     _supports_lazy_host = True   # DART/RF override: they touch host trees
+    _rows_streamed_dev = 0.0     # overwritten per-train; float for loaded
+                                 # boosters that never trained here
 
     # ------------------------------------------------------------ setup
     def _init_train(self, train_set: Dataset) -> None:
@@ -297,6 +299,10 @@ class GBDT:
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._bag_mask = jnp.ones((n,), dtype=jnp.float32)
         self._bag_sub = None
+        # compaction telemetry: rows read by histogram passes, accumulated
+        # ON DEVICE so the lazy dispatch pipeline never syncs for it
+        # (reading the properties below does)
+        self._rows_streamed_dev = jnp.float32(0.0)
         self._need_bagging = (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0) or \
             (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
 
@@ -619,11 +625,12 @@ class GBDT:
         cfg = self.config
         ts = self.train_set
         has_sp = getattr(ts, "has_sparse_cols", False)
+        fb = self._feature_block(hm)
         return dict(
             max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
             max_depth=cfg.max_depth, hist_method=hm,
             tile_leaves=cfg.tile_leaves, hist_block=cfg.hist_block,
-            feature_block=self._feature_block(hm),
+            feature_block=fb,
             exact=cfg.tree_growth_mode == "exact",
             with_categorical=ts.has_categorical,
             with_monotone=self._with_monotone,
@@ -631,7 +638,31 @@ class GBDT:
             mono_features=self._mono_features,
             extra_trees=cfg.extra_trees,
             hist_dp=self._hist_dp,
-            sp_cols=tuple(int(c) for c in ts.sp_cols) if has_sp else ())
+            hist_subtraction=cfg.hist_subtraction and fb == 0,
+            sp_cols=tuple(int(c) for c in ts.sp_cols) if has_sp else (),
+            compaction_ladder=() if fb else self._compaction_ladder())
+
+    def _compaction_ladder(self) -> tuple:
+        """Static row-buffer sizes for the grower's leaf-partitioned row
+        compaction (see grow_tree's compaction_ladder docstring — the
+        DataPartition analog). Rungs are ``hist_compaction_ladder``
+        fractions of the histogram row count (the bagging-subset copy's K
+        rows when that path is active), rounded up to a 64-row boundary;
+        rungs that don't undercut the full count are dropped — the full-N
+        pass is always the fallback."""
+        cfg = self.config
+        ts = self.train_set
+        if not cfg.hist_compaction or ts is None:
+            return ()
+        base = (self._bag_sub[0].shape[0] if self._bag_sub is not None
+                else (ts.num_local_data if getattr(self, "_pre_part", False)
+                      else ts.num_data))
+        rungs = set()
+        for fr in (cfg.hist_compaction_ladder or []):
+            m = -(-max(int(round(base * float(fr))), 1) // 64) * 64
+            if 0 < m < base:
+                rungs.add(m)
+        return tuple(sorted(rungs))
 
     def _fused_step_fn(self, hm: str):
         """One jitted program per boosting iteration for the serial fast
@@ -658,7 +689,7 @@ class GBDT:
         def step(score, bins, binsT, mask, fmask, sparams, iter_key, lr,
                  sp_rows, sp_bins, sp_default):
             g, h = obj.get_grad_hess(score)
-            tree, leaf_id, _aux = grow_tree(
+            tree, leaf_id, aux = grow_tree(
                 bins, g, h, mask, ts.feature_meta, sparams, fmask,
                 ts.missing_bin, binsT=binsT, rng_key=iter_key,
                 bundle_meta=ts.bundle_meta, sp_rows=sp_rows,
@@ -668,7 +699,7 @@ class GBDT:
             # rounding drifts 1 ulp from the unfused path and breaks the
             # bit-parity the serial-vs-parallel tests assert
             delta = leaf_values_of_rows(tree.leaf_value, leaf_id) * lr
-            return tree, leaf_id, delta
+            return tree, leaf_id, delta, aux.rows_streamed
 
         step = jax.jit(step)
         self._fused_cache[key] = step
@@ -713,6 +744,8 @@ class GBDT:
                 tree, leaf_id, aux = self._grow_one(gc, hc, mask, fmask,
                                                     iter_key, hm)
                 grow_scope.sync(tree.num_leaves)
+            if aux is not None:
+                self._record_rows_streamed(aux.rows_streamed)
             # pre-partitioned: leaf_id comes back row-sharded; keep only
             # this process's rows for the local score update (the
             # reference's per-machine score partition, score_updater.hpp —
@@ -765,7 +798,7 @@ class GBDT:
         iter_key = jax.random.fold_in(self._extra_rng_key, self.iter)
         step = self._fused_step_fn(hm)
         with profiling.timer_sync("grow_tree") as grow_scope:
-            tree, leaf_id, delta = step(
+            tree, leaf_id, delta, rows_streamed = step(
                 self.train_score, ts.bins,
                 ts.bins_T if self._use_binsT(hm) else None,
                 mask, fmask, self.split_params, iter_key,
@@ -774,6 +807,7 @@ class GBDT:
                 ts.sp_bins if has_sp else None,
                 ts.sp_default if has_sp else None)
             grow_scope.sync(tree.num_leaves)
+        self._record_rows_streamed(rows_streamed)
         new_score = self.train_score + delta
         lazy = self._lazy_host_ok()
         with profiling.timer("finalize_tree"):
@@ -828,6 +862,7 @@ class GBDT:
                 mono_mode=self._mono_mode,
                 mono_features=self._mono_features,
                 extra_trees=cfg.extra_trees,
+                hist_subtraction=cfg.hist_subtraction,
                 vote_top_k=cfg.top_k, hist_dp=self._hist_dp)
         sub = self._bag_sub
         has_sp = getattr(ts, "has_sparse_cols", False)
@@ -977,6 +1012,26 @@ class GBDT:
     def _sample_weights(self, g, h) -> Optional[jax.Array]:
         """Hook for GOSS-style reweighted sampling; None = use bag mask."""
         return None
+
+    def _record_rows_streamed(self, rows_streamed: jax.Array) -> None:
+        """Accumulate a tree's histogram-pass row count (device add, no
+        sync); mirror into the profiling counters when TIMETAG is on (the
+        grow_tree scope already synced, so the fetch is cheap there)."""
+        from ..utils import profiling
+        self._rows_streamed_dev = self._rows_streamed_dev + rows_streamed
+        if profiling.enabled():
+            profiling.counter("hist_rows_streamed", float(rows_streamed))
+
+    @property
+    def rows_streamed_total(self) -> float:
+        """Rows read by histogram passes across all trees so far — the
+        compaction telemetry bench.py reports next to sec_per_iter.
+        Reading this syncs the device accumulator."""
+        return float(self._rows_streamed_dev)
+
+    @property
+    def rows_streamed_per_tree(self) -> float:
+        return self.rows_streamed_total / max(len(self.trees), 1)
 
     def _finalize_tree(self, tree: TreeArrays, leaf_id: jax.Array,
                        class_idx: int) -> Tuple[TreeArrays, TreeArrays, bool]:
@@ -1429,13 +1484,53 @@ class GBDT:
         stacked = self._stacked()
         if stacked is not None:
             vals = np.asarray(predict_values_stacked(
-                stacked, ds.bins, ds.missing_bin), np.float64)  # [T, n]
+                stacked, self._traversal_bins(ds), ds.missing_bin),
+                np.float64)                                     # [T, n]
             biases = np.asarray(self.tree_bias, np.float64)[:, None]
             vals = vals - biases if len(self.tree_bias) == vals.shape[0] \
                 else vals
             for t in range(vals.shape[0]):
                 out[:, t % k] += vals[t]
         return out if k > 1 else out[:, 0]
+
+    def _traversal_bins(self, ds) -> jax.Array:
+        """Full-width bin matrix for tree traversal. Tree feature ids are
+        LOGICAL device-column positions, but a sparse-stored Dataset's
+        ``bins`` holds only the dense columns — traversing it directly
+        silently scores the wrong columns (ADVICE r5 high: binary_logloss
+        0.85 vs the true 0.28). Reconstruct the sparse columns from their
+        (row, bin) streams + default bin, the whole-column materialization
+        of SparseBin::Split's stream walk (sparse_bin.hpp). Costs the O(N)
+        dense matrix sparse storage elided — the price of eval-on-train;
+        cached ON the dataset so repeated eval calls pay it once per
+        dataset (not per alternation) and the matrix's lifetime follows
+        the dataset's (free_dataset releases it with the other device
+        storage)."""
+        if not getattr(ds, "has_sparse_cols", False):
+            return ds.bins
+        cache = getattr(ds, "_traversal_bins_cache", None)
+        if cache is not None:
+            return cache
+        n = ds.num_data
+        sp = np.asarray(ds.sp_cols)
+        f_dense = ds.bins.shape[1]
+        fc = f_dense + len(sp)
+        dtype = np.uint8 if ds.max_num_bins <= 256 else np.int32
+        full = np.zeros((n, fc), dtype)
+        dense_cols = np.setdiff1d(np.arange(fc), sp)
+        if f_dense:
+            full[:, dense_cols] = np.asarray(ds.bins)
+        rows = np.asarray(ds.sp_rows)
+        vals = np.asarray(ds.sp_bins)
+        defaults = np.asarray(ds.sp_default)
+        for i, c in enumerate(sp):
+            col = np.full(n, defaults[i], dtype)
+            ok = rows[i] < n                    # stream pad = out of range
+            col[rows[i][ok]] = vals[i][ok]
+            full[:, int(c)] = col
+        out = jnp.asarray(full)
+        ds._traversal_bins_cache = out
+        return out
 
     def predict_raw(self, X, num_iteration: Optional[int] = None,
                     start_iteration: int = 0,

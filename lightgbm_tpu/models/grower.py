@@ -16,7 +16,10 @@ Growth proceeds in ROUNDS inside a ``lax.while_loop``; each round is either
   computed and the larger is derived as parent - smaller (the reference's
   subtraction trick, serial_tree_learner.cpp:311-320: the parent's histogram
   is still resident in the slot the left child inherited, tracked by
-  ``parent_hist``), or
+  ``parent_hist``). With a ``compaction_ladder`` the pass first gathers
+  just the tile's rows into the smallest padded buffer that fits (the
+  DataPartition analog — see the grow_tree docstring) so non-root passes
+  stream O(pending rows), not O(N). Or,
 
   a SPLIT PHASE (entered when nothing is pending) — vectorized best-split
   search over all leaves, then an inner while_loop splitting leaves in gain
@@ -220,9 +223,11 @@ def advanced_child_bounds(lo, hi, out, act, monotone, num_bins: int,
 class GrowAux(NamedTuple):
     """Cross-iteration learner state returned alongside the tree (CEGB's
     feature-used tracking is global across the boosting run,
-    cost_effective_gradient_boosting.hpp:90-101)."""
+    cost_effective_gradient_boosting.hpp:90-101), plus per-tree counters."""
     used_split: jax.Array    # [F] bool: feature used in any split (CEGB coupled)
     row_used: jax.Array      # [N, F] bool or [1, 1] dummy (CEGB lazy)
+    rows_streamed: jax.Array  # f32 scalar: rows read by this tree's
+                              # histogram passes (compaction telemetry)
 
 
 class GrowState(NamedTuple):
@@ -253,6 +258,7 @@ class GrowState(NamedTuple):
     tree: TreeArrays
     num_leaves: jax.Array    # int32
     rounds: jax.Array        # int32
+    rows_streamed: jax.Array  # f32: rows read by histogram passes so far
 
 
 def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
@@ -452,7 +458,8 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
                      "use_bynode", "tile_leaves", "hist_block",
                      "hist_subtraction", "feature_block",
                      "feature_axis_name", "feature_shards", "voting",
-                     "vote_top_k", "hist_dp", "sp_cols"))
+                     "vote_top_k", "hist_dp", "sp_cols",
+                     "compaction_ladder"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
               feature_mask: jax.Array, missing_bin: jax.Array, *,
@@ -493,6 +500,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sp_rows: jax.Array | None = None,
               sp_bins: jax.Array | None = None,
               sp_default: jax.Array | None = None,
+              compaction_ladder: tuple = (),
               ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
     """Grow one tree. Returns (tree arrays, per-row leaf index, aux state).
 
@@ -531,6 +539,21 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         serial_tree_learner.cpp:311-320). Subtraction is exact for the count
         channel and float32-rounded for grad/hess (the reference subtracts in
         float64; its GPU path is float32 like ours).
+      compaction_ladder: static ascending tuple of row-buffer sizes for the
+        LEAF-PARTITIONED ROW COMPACTION path — the shape-static analog of
+        the reference's permuted per-leaf row partition
+        (data_partition.hpp:21-60; the optimization both GPU boosting
+        papers build on: arXiv:1706.08359 §4, arXiv:1806.11248 §3.3).
+        Before a tile pass the pending tile's rows are counted; the first
+        rung that fits gets a prefix-sum gather of just those rows
+        (ops/histogram.py compact_rows) and the histogram streams only the
+        buffer — with ``hist_subtraction`` every non-root pass covers the
+        SMALLER siblings, so <= N/2 rows fit from depth 1 and the covered
+        row count shrinks geometrically with depth, restoring the
+        reference's O(N * depth) histogram asymptotics. The full-N pass
+        remains the fallback rung (chosen via lax.cond inside the jitted
+        while_loop, so every rung is compiled once). Empty = always
+        full-N. Serial learner only.
       feature_block: > 0 engages the MEMORY-BOUNDED mode for wide datasets:
         no [L, F, B, 3] histogram state is kept at all — each pending leaf
         is histogrammed and searched immediately, ``feature_block`` columns
@@ -592,6 +615,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
     else:
         sp_np = dense_np = None
         sp_pack = None
+    if compaction_ladder:
+        assert (axis_name is None and feature_axis_name is None
+                and not voting and feature_block == 0), (
+            "hist compaction is serial-only; the caller must pass an empty "
+            "ladder for parallel/blocked learners")
+        assert tuple(sorted(compaction_ladder)) == tuple(compaction_ladder), (
+            "compaction_ladder must be ascending")
     L = max_leaves
     tile_leaves = tile_leaves or 42     # 0 = auto
     P = min(tile_leaves, L) if hist_method.startswith(("onehot", "pallas")) \
@@ -802,6 +832,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             tree=empty_tree(L, cat_words),
             num_leaves=jnp.int32(1),
             rounds=jnp.int32(0),
+            rows_streamed=jnp.float32(0.0),
         )
 
     def active_mask(state: GrowState) -> jax.Array:
@@ -939,14 +970,52 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         sel = jnp.where(chosen_ok, chosen, -1)
 
         hist_leaf_ids = state.leaf_id_sub if use_subset else state.leaf_id
-        if f_dense > 0:
-            tile = histogram_tiles(bins_h, stats, hist_leaf_ids, sel,
-                                   num_bins, method=hist_method,
-                                   dtype=hist_dtype,
-                                   binsT=binsT_h, block=hist_block)
+        n_rows = hist_leaf_ids.shape[0]
+
+        def full_pass():
+            t = histogram_tiles(bins_h, stats, hist_leaf_ids, sel,
+                                num_bins, method=hist_method,
+                                dtype=hist_dtype,
+                                binsT=binsT_h, block=hist_block)
+            return t, jnp.float32(n_rows)
+
+        if f_dense > 0 and compaction_ladder:
+            # leaf-partitioned row compaction (see the compaction_ladder
+            # docstring): count the tile's rows via an O(L) slot lookup,
+            # then dispatch to the smallest precompiled rung that fits
+            slot_map = jnp.full((L + 1,), P, jnp.int32).at[
+                jnp.where(sel >= 0, sel, L)].set(
+                    jnp.arange(P, dtype=jnp.int32))
+            in_tile = slot_map[hist_leaf_ids] < P
+            n_pend = jnp.sum(in_tile, dtype=jnp.int32)
+
+            def compact_pass(m):
+                def fn():
+                    from ..ops.histogram import compact_rows
+                    bm, btm, st, lid = compact_rows(
+                        bins_h, binsT_h, stats, hist_leaf_ids, in_tile, m)
+                    t = histogram_tiles(bm, st, lid, sel, num_bins,
+                                        method=hist_method,
+                                        dtype=hist_dtype,
+                                        binsT=btm, block=hist_block)
+                    return t, jnp.float32(m)
+                return fn
+
+            # nest largest-first so the OUTERMOST cond tests the smallest
+            # rung: if n_pend <= m_small take it, else fall through
+            branch = full_pass
+            for m in sorted(compaction_ladder, reverse=True):
+                branch = (lambda m=m, nxt=branch:
+                          jax.lax.cond(n_pend <= m, compact_pass(m),
+                                       lambda: nxt()))
+            tile, streamed = branch()
+        elif f_dense > 0:
+            tile, streamed = full_pass()
         else:
             tile = jnp.zeros((P, 0, num_bins, stats.shape[1]),
                              jnp.int32 if quant8 else hist_dtype)
+            streamed = jnp.float32(n_rows)    # sparse streams still walk
+                                              # the full leaf-id vector
         if f_sp:
             tile = combine_sparse(tile, sel, hist_leaf_ids, stats)
         if dp_scatter:
@@ -982,7 +1051,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             hist=hist,
             hist_valid=state.hist_valid | resolved,
             parent_hist=state.parent_hist & ~resolved,
-            rounds=state.rounds + 1)
+            rounds=state.rounds + 1,
+            rows_streamed=state.rows_streamed + streamed)
 
     def intermediate_bounds(state: GrowState) -> GrowState:
         """Exact per-leaf output bounds from ALL current leaf outputs and
@@ -1265,7 +1335,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             best=new_best,
             hist_valid=state.hist_valid.at[chosen].set(
                 state.hist_valid[chosen] | chosen_ok),
-            rounds=state.rounds + 1)
+            rounds=state.rounds + 1,
+            rows_streamed=state.rows_streamed
+            + jnp.float32(n * (-(-f // feature_block))))
 
     def apply_splits(state: GrowState, gain_eff: jax.Array,
                      apply_kw: dict) -> GrowState:
@@ -1337,4 +1409,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                             tile_pass, split_phase, state)
 
     state = jax.lax.while_loop(outer_cond, outer_body, init_state())
-    return state.tree, state.leaf_id, GrowAux(state.used_split, state.row_used)
+    rows_streamed = state.rows_streamed
+    if axis_name is not None:
+        # global rows per tree across the row shards (each shard counted
+        # only its local rows)
+        rows_streamed = jax.lax.psum(rows_streamed, axis_name)
+    return state.tree, state.leaf_id, GrowAux(state.used_split,
+                                              state.row_used, rows_streamed)

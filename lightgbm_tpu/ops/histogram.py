@@ -111,6 +111,50 @@ def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     return parent - child
 
 
+def compact_rows(bins: jax.Array | None, binsT: jax.Array | None,
+                 stats: jax.Array, leaf_ids: jax.Array, keep: jax.Array,
+                 size: int):
+    """Prefix-sum compaction of the ``keep`` rows into statically-shaped
+    padded buffers of ``size`` rows — the shape-static analog of the
+    reference's permuted per-leaf row partition (data_partition.hpp:21-60):
+    a tile pass over the compacted buffer costs O(size) instead of O(N).
+
+    The kept rows land in ORIGINAL row order (jnp.nonzero is a stable
+    prefix-sum compaction), so a scatter-add histogram over the buffer
+    accumulates each cell's contributions in exactly the order of the
+    full-N pass — bit-identical sums there; the matmul backends regroup
+    partial sums (see the onehot scan) and match to accumulation-order
+    tolerance like every other pass-shape change.
+
+    Padded slots carry zero stats and leaf id -2, which matches no tile
+    ``sel`` entry (active slots are >= 0, inactive -1), so every backend
+    drops them. The caller guarantees ``sum(keep) <= size`` (the grower's
+    ladder dispatch conditions on the pending row count).
+
+    Args:
+      bins: [N, F] row-major bin matrix or None (sparse-only datasets).
+      binsT: [F, N] feature-major copy or None.
+      stats: [N, S] per-row statistics (any accumulation dtype).
+      leaf_ids: [N] int32 leaf slot per row.
+      keep: [N] bool: row belongs to the tile's pending leaves.
+      size: static output row count.
+
+    Returns:
+      (bins_c, binsT_c, stats_c, leaf_ids_c) with ``size`` rows each
+      (None stays None).
+    """
+    n = leaf_ids.shape[0]
+    idx = jnp.nonzero(keep, size=size, fill_value=n)[0].astype(jnp.int32)
+    ok = idx < n
+    idxc = jnp.minimum(idx, n - 1)
+    stats_c = jnp.where(ok[:, None], jnp.take(stats, idxc, axis=0),
+                        jnp.zeros((), stats.dtype))
+    leaf_ids_c = jnp.where(ok, jnp.take(leaf_ids, idxc), jnp.int32(-2))
+    bins_c = None if bins is None else jnp.take(bins, idxc, axis=0)
+    binsT_c = None if binsT is None else jnp.take(binsT, idxc, axis=1)
+    return bins_c, binsT_c, stats_c, leaf_ids_c
+
+
 def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 

@@ -94,7 +94,9 @@ def _hist_pallas_call(binsT, rhs, *, num_bins, block, mode):
         ],
         out_specs=pl.BlockSpec((f * num_bins, _PAD), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((f * num_bins, _PAD), out_dtype),
-        compiler_params=pltpu.CompilerParams(
+        # CompilerParams was TPUCompilerParams before jax 0.5
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("arbitrary",),
             # the default 16M scoped-vmem cap rejects the q8 mode at full
             # Higgs scale (measured 2026-07-30: int8 accumulation needed a
@@ -145,6 +147,11 @@ def histogram_tiles_pallas_mode(binsT, stats, leaf_ids, sel, num_bins,
     (6-pass, precise), or "q8" (int8 stats -> exact int32 histograms for
     the quantized-gradient training mode; ~2x hilo's MXU rate).
     Takes the FEATURE-MAJOR bin matrix [F, N].
+
+    The grid is ``ceil(N / block)`` row steps, so the grower's row
+    compaction (ops/histogram.py compact_rows) shrinks the kernel's grid
+    in proportion to the ladder rung: a [F, N/8] compacted buffer runs an
+    8x smaller grid than the full pass, same per-step working set.
     """
     f = binsT.shape[0]
     p = sel.shape[0]

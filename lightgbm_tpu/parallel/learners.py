@@ -39,6 +39,18 @@ from .data_parallel import make_mesh
 PARALLEL_MODES = ("data", "feature", "voting")
 
 
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """jax.shard_map became top-level API after 0.4.x (with check_rep
+    renamed to check_vma); fall back to the experimental location so the
+    parallel learners import on both."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def _pad_cols(b, *, f_pad):
     return jnp.pad(b, ((0, 0), (0, f_pad)))
 
@@ -123,9 +135,9 @@ class ParallelGrower:
         leaf_spec = P() if gather_leaf else row
         in_specs = (row2, row, row, row, P(), P(), P(), P(), extras_spec,
                     P())
-        out_specs = (P(), leaf_spec, GrowAux(P(), P()))
-        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
+        out_specs = (P(), leaf_spec, GrowAux(P(), P(), P()))
+        return _shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                          out_specs=out_specs)
 
     def _to_global(self, arr, spec, key=None):
         """Multi-controller: build a GLOBAL array from this process's full

@@ -24,6 +24,11 @@ from typing import Dict, Iterator, Optional
 _enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
 _acc: Dict[str, float] = defaultdict(float)
 _cnt: Dict[str, int] = defaultdict(int)
+# named value counters (work counts rather than wall time): the analog of
+# the reference's global_timer also carrying histogram-construction counts;
+# used for the compaction telemetry (rows streamed per histogram pass)
+_counters: Dict[str, float] = defaultdict(float)
+_counter_cnt: Dict[str, int] = defaultdict(int)
 
 
 def enable(on: bool = True) -> None:
@@ -38,6 +43,23 @@ def enabled() -> bool:
 def reset() -> None:
     _acc.clear()
     _cnt.clear()
+    _counters.clear()
+    _counter_cnt.clear()
+
+
+def counter(name: str, value: float) -> None:
+    """Accumulate a named work counter (e.g. ``hist_rows_streamed``).
+    Cheap no-op when profiling is disabled; callers should avoid forcing a
+    device sync just to record one (fetch an already-synced value)."""
+    if not _enabled:
+        return
+    _counters[name] += float(value)
+    _counter_cnt[name] += 1
+
+
+def counters() -> Dict[str, float]:
+    """Accumulated named counters (empty when profiling is disabled)."""
+    return dict(_counters)
 
 
 @contextmanager
@@ -100,16 +122,29 @@ class timer_sync:
 
 def table() -> str:
     """Aggregated per-scope wall-time table (reference: the USE_TIMETAG
-    summary printed by ~Timer, common.h:970-990)."""
-    if not _acc:
+    summary printed by ~Timer, common.h:970-990), followed by the named
+    work counters."""
+    if not _acc and not _counters:
         return "(no timer scopes recorded)"
-    width = max(len(k) for k in _acc)
-    lines = [f"{'scope'.ljust(width)}  {'calls':>7}  {'total s':>10}  "
-             f"{'mean ms':>10}"]
-    for name in sorted(_acc, key=lambda k: -_acc[k]):
-        n = _cnt[name]
-        lines.append(f"{name.ljust(width)}  {n:>7}  {_acc[name]:>10.3f}  "
-                     f"{1e3 * _acc[name] / max(n, 1):>10.2f}")
+    lines = []
+    if _acc:
+        width = max(len(k) for k in _acc)
+        lines.append(f"{'scope'.ljust(width)}  {'calls':>7}  "
+                     f"{'total s':>10}  {'mean ms':>10}")
+        for name in sorted(_acc, key=lambda k: -_acc[k]):
+            n = _cnt[name]
+            lines.append(f"{name.ljust(width)}  {n:>7}  "
+                         f"{_acc[name]:>10.3f}  "
+                         f"{1e3 * _acc[name] / max(n, 1):>10.2f}")
+    if _counters:
+        width = max(len(k) for k in _counters)
+        lines.append(f"{'counter'.ljust(width)}  {'calls':>7}  "
+                     f"{'total':>14}  {'mean':>14}")
+        for name in sorted(_counters, key=lambda k: -_counters[k]):
+            n = _counter_cnt[name]
+            lines.append(f"{name.ljust(width)}  {n:>7}  "
+                         f"{_counters[name]:>14.0f}  "
+                         f"{_counters[name] / max(n, 1):>14.1f}")
     return "\n".join(lines)
 
 

@@ -24,17 +24,23 @@ OUT = os.path.join(os.path.dirname(__file__), "..", "docs", "Parameters.md")
 
 def _sections():
     """Field name -> section title, from the '# Section' comments that
-    precede field groups in the dataclass body."""
+    precede field groups in the dataclass body. A comment counts as a
+    section title only when a BLANK line precedes it (section comments are
+    blank-line-separated groups); continuation lines of multi-line field
+    comments must not be promoted to headings."""
     import inspect
     src = inspect.getsource(Config)
     section = "Core"
     out = {}
+    prev_blank = False
     for line in src.splitlines():
         stripped = line.strip()
         m = re.match(r"#\s+(.*)", stripped)
-        if m and ":" not in stripped:
+        if m and ":" not in stripped and prev_blank:
             section = m.group(1)
+            prev_blank = False
             continue
+        prev_blank = not stripped
         fm = re.match(r"(\w+)\s*:\s*\S", stripped)
         if fm and not stripped.startswith(("def ", "class ")):
             out[fm.group(1)] = section

@@ -8,9 +8,13 @@ Usage: python scripts/microbench_hist.py [--rows 10500000] [--reps 5]
 """
 
 import argparse
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def sync(x):
@@ -36,6 +40,9 @@ def main():
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--only", type=str, default=None,
                     help="comma-separated subset of variant names")
+    ap.add_argument("--pend-frac", type=float, default=0.25,
+                    help="pending-row fraction for the compacted-pass "
+                         "variants (gather + histogram over the rung)")
     args = ap.parse_args()
 
     import jax
@@ -94,6 +101,36 @@ def main():
                 lambda blk=blk: pallas_hist.histogram_tiles_pallas_mode(
                     binsT, stats_q, leaf_ids, sel, b, block=blk,
                     mode="q8")))
+
+    # compacted passes (grower ladder analog): leaf ids drawn over 1/frac
+    # as many leaves as the tile selects, so ~frac of the rows are pending;
+    # the variant times gather (compact_rows) + histogram over the rung —
+    # the full end-to-end cost the ladder pays per tile round
+    from lightgbm_tpu.ops.histogram import compact_rows
+
+    frac = args.pend_frac
+    spread = max(1, int(round(1.0 / max(frac, 1e-6))))
+    leaf_wide = jnp.asarray(
+        rng.randint(0, spread * p, size=n).astype(np.int32))
+    in_tile = leaf_wide < p
+    # size the rung from the ACTUAL pending count (the grower's lax.cond
+    # guarantees n_pend <= rung before dispatching; the variant must honor
+    # the same compact_rows contract or it silently drops pending rows)
+    rung = -(-int(np.asarray(jnp.sum(in_tile))) // 512) * 512
+
+    def compacted(method, use_binsT):
+        def fn():
+            bm, btm, st, lid = compact_rows(
+                bins, binsT if use_binsT else None, stats, leaf_wide,
+                in_tile, rung)
+            from lightgbm_tpu.ops.histogram import histogram_tiles
+            return histogram_tiles(bm, st, lid, sel, b, method=method,
+                                   binsT=btm)
+        return jax.jit(fn)
+
+    bench(f"compact{frac:.2f}_scatter", compacted("scatter", False))
+    bench(f"compact{frac:.2f}_onehot_hilo", compacted("onehot_hilo", True))
+    bench(f"compact{frac:.2f}_pallas_hilo", compacted("pallas_hilo", True))
 
     if results:
         best = min(results, key=results.get)

