@@ -8,11 +8,15 @@ training over device meshes, and a Python API mirroring the reference's
 python-package surface (Dataset/Booster/train/cv/sklearn wrappers).
 """
 
-from . import distributed
+from . import checkpoint, distributed
 from .basic import Dataset
 from .booster import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        print_evaluation, record_evaluation, reset_parameter)
+# the checkpoint CALLBACK exports as checkpoint_callback: the bare name
+# `checkpoint` is bound (by the explicit submodule import above) to the
+# lightgbm_tpu.checkpoint submodule (CheckpointManager and friends)
+from .callback import checkpoint as checkpoint_callback
 from .config import Config
 from .engine import CVBooster, cv, train
 from .utils.log import register_logger
@@ -23,6 +27,7 @@ __all__ = [
     "Dataset", "Booster", "Config", "train", "cv", "CVBooster",
     "register_logger", "early_stopping", "print_evaluation", "log_evaluation",
     "record_evaluation", "reset_parameter", "EarlyStopException",
+    "checkpoint_callback",
 ]
 
 

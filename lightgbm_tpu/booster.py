@@ -47,6 +47,12 @@ class Booster:
             merged.update(self.params)
             train_set.params = merged
             self._boosting = create_boosting(self.config, train_set)
+            # params identity BEFORE any mid-training reset_parameter
+            # mutation: both the checkpointing and the resuming run hash
+            # their construction-time config, so learning-rate schedules
+            # don't produce spurious resume mismatches
+            from .checkpoint import params_hash
+            self._initial_params_hash = params_hash(self.config)
         else:
             raise ValueError("need at least one of train_set, model_file or model_str")
 
@@ -135,8 +141,12 @@ class Booster:
     # ------------------------------------------------------------ model IO
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        with open(filename, "w") as fh:
-            fh.write(self.model_to_string(num_iteration, start_iteration))
+        # atomic (tmp + fsync + rename): a crash mid-write must leave the
+        # previous file, never a truncated model.txt that parses into a
+        # silently shorter model
+        from .utils.atomic_write import atomic_write_text
+        atomic_write_text(filename,
+                          self.model_to_string(num_iteration, start_iteration))
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,

@@ -73,7 +73,20 @@ def record_evaluation(eval_result: dict) -> Callable:
             eval_result.setdefault(data_name, collections.OrderedDict())
             eval_result[data_name].setdefault(eval_name, [])
             eval_result[data_name][eval_name].append(result)
+
+    def _get_state():
+        return {d: {m: list(v) for m, v in metrics.items()}
+                for d, metrics in eval_result.items()}
+
+    def _set_state(state):
+        eval_result.clear()
+        for d, metrics in state.items():
+            eval_result[d] = collections.OrderedDict(
+                (m, list(v)) for m, v in metrics.items())
     _callback.order = 20
+    _callback.ckpt_key = "record_evaluation"
+    _callback.get_state = _get_state
+    _callback.set_state = _set_state
     return _callback
 
 
@@ -163,5 +176,60 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                              + "\t".join(_format_eval_result(x) for x in best_score_list[i]))
                 raise EarlyStopException(best_iter[i], best_score_list[i])
             _final_iteration_check(env, eval_name, i)
+
+    def _get_state():
+        # cmp_op closures can't pickle: persist the bigger-is-better flags
+        # and rebuild the comparators on restore
+        return {"best_score": list(best_score), "best_iter": list(best_iter),
+                "best_score_list": list(best_score_list),
+                "bigger": [op(1.0, 0.0) for op in cmp_op],
+                "enabled": enabled[0], "first_metric": first_metric[0]}
+
+    def _set_state(state):
+        del best_score[:], best_iter[:], best_score_list[:], cmp_op[:]
+        best_score.extend(state["best_score"])
+        best_iter.extend(state["best_iter"])
+        best_score_list.extend(state["best_score_list"])
+        for bigger in state["bigger"]:
+            cmp_op.append((lambda x, y: x > y) if bigger
+                          else (lambda x, y: x < y))
+        enabled[0] = state["enabled"]
+        first_metric[0] = state["first_metric"]
     _callback.order = 30
+    _callback.ckpt_key = "early_stopping"
+    _callback.get_state = _get_state
+    _callback.set_state = _set_state
+    return _callback
+
+
+def checkpoint(directory: str, period: int = 1, keep: int = 2) -> Callable:
+    """Atomic training checkpoints every ``period`` iterations (see
+    lightgbm_tpu/checkpoint.py for the layout and guarantees). Resume with
+    ``train(..., resume_from=directory)`` — kill-at-k + resume reproduces
+    the uninterrupted run bit-identically. ``keep`` >= 2 retains a
+    fallback when the newest checkpoint is later found truncated/corrupt.
+
+    Runs at order 40 — after ``record_evaluation`` (20) and
+    ``early_stopping`` (30) — so the callback states it captures are
+    current through the checkpointed iteration."""
+    from .checkpoint import CheckpointManager
+    state = {"mgr": None, "warned": False}
+
+    def _callback(env: CallbackEnv) -> None:
+        model = env.model
+        boosting = getattr(model, "_boosting", None)
+        if boosting is None or not hasattr(boosting, "get_trainer_state"):
+            if not state["warned"]:
+                state["warned"] = True
+                log.warning("checkpoint callback: model does not support "
+                            "trainer-state capture (cv / loaded boosters "
+                            "are not checkpointable); skipping")
+            return
+        if period <= 0 or (env.iteration + 1) % period != 0:
+            return
+        if state["mgr"] is None:
+            state["mgr"] = CheckpointManager(directory, keep=keep,
+                                             config=model.config)
+        state["mgr"].save(model, env.iteration + 1)
+    _callback.order = 40
     return _callback

@@ -1,0 +1,289 @@
+"""Atomic checkpoint/resume for fault-tolerant training.
+
+The reference's only mid-training persistence is ``snapshot_freq`` model
+dumps (gbdt.cpp:277-281): non-atomic in-place writes that lose all trainer
+state — DART's drop RNG, the feature-fraction RNG, bagging phase, eval
+history, early-stopping counters — so a "resume" from one silently trains
+a DIFFERENT model. This module makes resumable boosting a design point
+(the TF Boosted Trees stance, arXiv:1710.11555): a checkpoint captures
+the model text PLUS a trainer-state sidecar, every file lands via
+``utils/atomic_write`` (tmp + fsync + rename), and a manifest written
+LAST records byte lengths + sha256 checksums so a kill at any point
+leaves either a fully valid checkpoint or one that validation rejects.
+
+Layout under the checkpoint directory::
+
+    ckpt_00000007/
+        model.txt       v3 model text (interop: loads as a normal model)
+        state.pkl       pickled trainer state (trees, scores, RNGs, ...)
+        MANIFEST.json   iteration, params hash, dataset fingerprint,
+                        per-file {bytes, sha256}; its presence marks the
+                        checkpoint complete
+
+``load_latest_valid`` walks checkpoints newest-first and falls back past
+any truncated/corrupt one with a warning. Resume is BIT-IDENTICAL: the
+sidecar restores the exact float32 score caches, device tree arrays and
+RNG states, so kill-at-k + resume reproduces the uninterrupted run's
+model text byte for byte (tests/test_fault_tolerance.py asserts this for
+gbdt/dart/goss with bagging).
+
+Multi-process runs write from rank 0 only, with a cross-process barrier
+after the save so no rank races ahead of a checkpoint that may later be
+resumed from.
+
+Note: ``state.pkl`` is a pickle — load checkpoints only from directories
+you trust, like any model artifact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import shutil
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from .utils import log
+from .utils import faults
+from .utils.atomic_write import atomic_write_bytes, atomic_write_text
+
+MANIFEST_NAME = "MANIFEST.json"
+MODEL_NAME = "model.txt"
+STATE_NAME = "state.pkl"
+_CKPT_RE = re.compile(r"^ckpt_(\d{8})$")
+MANIFEST_FORMAT = 1
+
+# params that steer IO/logging/injection but not the trained model — they
+# may differ between the checkpointing run and the resuming run
+_NON_TRAINING_PARAMS = frozenset({
+    "task", "data", "valid", "input_model", "output_model", "output_result",
+    "convert_model", "convert_model_language", "verbosity", "snapshot_freq",
+    "metric_freq", "num_threads", "machine_list_filename",
+    "checkpoint_path", "checkpoint_keep", "check_numerics",
+    "fault_kill_at_iter", "fault_nan_grad_at_iter",
+    "fault_corrupt_checkpoint",
+})
+
+
+def params_hash(config) -> str:
+    """Stable hash of the training-relevant parameters: resuming under a
+    different configuration must be detected, not silently train a
+    different model. Walks the full Config field set directly —
+    ``to_params()`` omits list-typed fields (default_factory), which would
+    blind the check to monotone/interaction constraints, per-feature bins,
+    metric lists etc."""
+    import dataclasses
+    items = sorted(
+        (f.name, repr(getattr(config, f.name)))
+        for f in dataclasses.fields(type(config))
+        if f.name not in _NON_TRAINING_PARAMS)
+    return hashlib.sha256(repr(items).encode()).hexdigest()[:16]
+
+
+def dataset_fingerprint(train_set) -> str:
+    """Cheap identity check for the training data: shape plus label/weight
+    bytes (not a full data hash — the point is catching 'resumed on a
+    different dataset', not bit-auditing features)."""
+    import numpy as np
+    h = hashlib.sha256()
+    n = int(getattr(train_set, "num_data", 0) or 0)
+    f = int(getattr(train_set, "num_total_features", 0) or 0)
+    h.update(f"{n}x{f}".encode())
+    label = train_set.get_label() if hasattr(train_set, "get_label") else None
+    if label is not None:
+        h.update(np.ascontiguousarray(np.asarray(label, np.float64)).tobytes())
+    weight = train_set.get_weight() if hasattr(train_set, "get_weight") else None
+    if weight is not None:
+        h.update(np.ascontiguousarray(np.asarray(weight, np.float64)).tobytes())
+    return h.hexdigest()[:16]
+
+
+def capture_state(booster) -> Dict[str, Any]:
+    """Full trainer state of a training booster: the boosting layer's state
+    (trees, score caches, RNGs — see GBDT.get_trainer_state) plus
+    booster-level fields and the states of any stateful callbacks the
+    engine registered on the booster."""
+    state: Dict[str, Any] = {
+        "format": MANIFEST_FORMAT,
+        "boosting": booster._boosting.get_trainer_state(),
+        "booster": {
+            "best_iteration": booster.best_iteration,
+            "best_score": dict(booster.best_score),
+            "attr": dict(getattr(booster, "_attr", {}) or {}),
+        },
+        "callbacks": {},
+    }
+    for cb in getattr(booster, "_callbacks", []) or []:
+        key = getattr(cb, "ckpt_key", None)
+        if key and hasattr(cb, "get_state"):
+            state["callbacks"][key] = cb.get_state()
+    return state
+
+
+@dataclass
+class LoadedCheckpoint:
+    path: str
+    iteration: int
+    manifest: Dict[str, Any]
+    model_text: str
+    state: Dict[str, Any]
+
+
+class CheckpointManager:
+    """Writes, validates, prunes and loads checkpoints in one directory."""
+
+    def __init__(self, directory: str, keep: int = 2, config=None):
+        self.directory = os.fspath(directory)
+        self.keep = max(1, int(keep))
+        self._fault_plan = faults.plan_from(config)
+        self._dataset_fp: Optional[str] = None
+
+    # ------------------------------------------------------------- write
+    def save(self, booster, iteration: int) -> Optional[str]:
+        """Checkpoint ``booster`` after ``iteration`` completed boosting
+        iterations. Rank 0 writes; every rank barriers after, so no
+        process races past a checkpoint another may resume from."""
+        import jax
+        from . import distributed
+        path = None
+        if jax.process_count() <= 1 or jax.process_index() == 0:
+            path = self._write(booster, iteration)
+        distributed.barrier(f"lgbm_tpu_checkpoint_{iteration}")
+        return path
+
+    def _write(self, booster, iteration: int) -> str:
+        name = f"ckpt_{iteration:08d}"
+        path = os.path.join(self.directory, name)
+        os.makedirs(path, exist_ok=True)
+        model_bytes = booster.model_to_string(num_iteration=-1).encode()
+        state_bytes = pickle.dumps(capture_state(booster), protocol=4)
+        atomic_write_bytes(os.path.join(path, MODEL_NAME), model_bytes)
+        atomic_write_bytes(os.path.join(path, STATE_NAME), state_bytes)
+        if self._dataset_fp is None:
+            self._dataset_fp = dataset_fingerprint(
+                booster._boosting.train_set)
+        phash = getattr(booster, "_initial_params_hash", None) \
+            or params_hash(booster.config)
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "iteration": int(iteration),
+            "params_hash": phash,
+            "dataset_fingerprint": self._dataset_fp,
+            "files": {
+                MODEL_NAME: {"bytes": len(model_bytes),
+                             "sha256": hashlib.sha256(model_bytes).hexdigest()},
+                STATE_NAME: {"bytes": len(state_bytes),
+                             "sha256": hashlib.sha256(state_bytes).hexdigest()},
+            },
+        }
+        # the manifest lands LAST: its presence marks the checkpoint
+        # complete, so a kill between the writes above leaves a directory
+        # that load_latest_valid skips
+        atomic_write_text(os.path.join(path, MANIFEST_NAME),
+                          json.dumps(manifest, indent=1, sort_keys=True))
+        faults.maybe_corrupt_checkpoint(self._fault_plan,
+                                        os.path.join(path, MODEL_NAME))
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        ckpts = self.checkpoints()
+        for _it, path in ckpts[:-self.keep]:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # -------------------------------------------------------------- read
+    def checkpoints(self) -> List[Tuple[int, str]]:
+        """(iteration, path) pairs sorted ascending by iteration."""
+        out = []
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return []
+        for entry in entries:
+            m = _CKPT_RE.match(entry)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, entry)))
+        return sorted(out)
+
+    def validate(self, path: str) -> Dict[str, Any]:
+        """Parse + integrity-check one checkpoint's manifest; raises
+        ValueError naming what failed."""
+        mpath = os.path.join(path, MANIFEST_NAME)
+        if not os.path.exists(mpath):
+            raise ValueError("no manifest (checkpoint write did not complete)")
+        try:
+            with open(mpath) as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise ValueError(f"unreadable manifest: {e}")
+        if manifest.get("format") != MANIFEST_FORMAT:
+            raise ValueError(f"unknown manifest format "
+                             f"{manifest.get('format')!r}")
+        for fname, meta in manifest.get("files", {}).items():
+            fpath = os.path.join(path, fname)
+            if not os.path.exists(fpath):
+                raise ValueError(f"missing file {fname}")
+            size = os.path.getsize(fpath)
+            if size != int(meta["bytes"]):
+                raise ValueError(f"{fname} is {size} bytes, manifest says "
+                                 f"{meta['bytes']} (truncated?)")
+            with open(fpath, "rb") as fh:
+                digest = hashlib.sha256(fh.read()).hexdigest()
+            if digest != meta["sha256"]:
+                raise ValueError(f"{fname} checksum mismatch (corrupt)")
+        return manifest
+
+    def load_latest_valid(self) -> Optional[LoadedCheckpoint]:
+        """Newest checkpoint that passes integrity validation, falling back
+        past truncated/corrupt ones with a warning; None when the
+        directory holds no valid checkpoint."""
+        for iteration, path in reversed(self.checkpoints()):
+            try:
+                manifest = self.validate(path)
+                with open(os.path.join(path, MODEL_NAME), encoding="utf-8") as fh:
+                    model_text = fh.read()
+                with open(os.path.join(path, STATE_NAME), "rb") as fh:
+                    state = pickle.load(fh)
+            except (ValueError, OSError, pickle.UnpicklingError, EOFError) as e:
+                log.warning(f"checkpoint {os.path.basename(path)} is corrupt "
+                            f"or truncated ({e}); falling back to the "
+                            f"previous checkpoint")
+                continue
+            return LoadedCheckpoint(path=path, iteration=iteration,
+                                    manifest=manifest, model_text=model_text,
+                                    state=state)
+        return None
+
+
+def restore_booster(booster, ckpt: LoadedCheckpoint) -> Dict[str, Any]:
+    """Restore a freshly constructed training booster to the checkpointed
+    state after validating that params and dataset match what the
+    checkpoint was written with. Returns the saved callback states (keyed
+    by ``ckpt_key``) for the engine to hand to its callbacks."""
+    phash = getattr(booster, "_initial_params_hash", None) \
+        or params_hash(booster.config)
+    want = ckpt.manifest.get("params_hash")
+    if want and want != phash:
+        log.fatal(
+            f"cannot resume from {ckpt.path}: it was written with different "
+            f"training parameters (params_hash {want} != {phash}) — "
+            f"resuming would silently train a different model. Use the "
+            f"original parameters, or delete the checkpoint directory to "
+            f"start fresh.")
+    fp = dataset_fingerprint(booster._boosting.train_set)
+    want_fp = ckpt.manifest.get("dataset_fingerprint")
+    if want_fp and want_fp != fp:
+        log.fatal(
+            f"cannot resume from {ckpt.path}: it was written against a "
+            f"different training dataset (fingerprint {want_fp} != {fp}).")
+    booster._boosting.set_trainer_state(ckpt.state["boosting"])
+    b = ckpt.state.get("booster", {})
+    booster.best_iteration = b.get("best_iteration", -1)
+    booster.best_score = dict(b.get("best_score", {}))
+    if b.get("attr"):
+        booster._attr = dict(b["attr"])
+    return dict(ckpt.state.get("callbacks", {}))

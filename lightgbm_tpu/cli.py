@@ -138,8 +138,12 @@ def load_data_file(path: str, config: Config
 
 def _save_binary(path: str, X, y, weight, group, init_score) -> None:
     """Dataset binary serialization (reference: dataset_loader.cpp:316
-    LoadFromBinFile / save_binary — here a versioned npz container)."""
-    with open(path, "wb") as fh:   # file object: np.savez won't append .npz
+    LoadFromBinFile / save_binary — here a versioned npz container),
+    written atomically (a killed save must not leave a truncated .bin a
+    later run would trip over). Streams straight into the tmp file — no
+    in-memory copy of the compressed archive."""
+    from .utils.atomic_write import atomic_open
+    with atomic_open(path) as fh:   # file object: np.savez won't append .npz
         np.savez_compressed(fh, version=1, X=X, y=y,
                             weight=weight if weight is not None else np.zeros(0),
                             group=group if group is not None else np.zeros(0),
@@ -398,16 +402,22 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         valid_names.append(os.path.basename(vf))
 
     callbacks = []
+    resume_from = None
     if config.snapshot_freq > 0:
-        # model.txt.snapshot_iter_N files (gbdt.cpp:277-281)
-        out = config.output_model
-
-        def snapshot_cb(env):
-            it = env.iteration + 1
-            if it % config.snapshot_freq == 0:
-                env.model.save_model(f"{out}.snapshot_iter_{it}")
-        snapshot_cb.order = 100
-        callbacks.append(snapshot_cb)
+        # snapshot_freq rides the atomic checkpoint subsystem (replacing
+        # the reference's non-atomic model.txt.snapshot_iter_N dumps,
+        # gbdt.cpp:277-281): full trainer state, manifest-validated files,
+        # and AUTO-RESUME — a killed run restarted with the same command
+        # continues bit-identically from the newest valid checkpoint
+        from . import callback as callback_mod
+        ckpt_dir = config.checkpoint_path or (config.output_model + ".ckpt")
+        callbacks.append(callback_mod.checkpoint(
+            ckpt_dir, period=config.snapshot_freq,
+            keep=config.checkpoint_keep))
+        if os.path.isdir(ckpt_dir):
+            resume_from = ckpt_dir
+            log.info(f"checkpoint directory {ckpt_dir} exists; resuming "
+                     f"from the newest valid checkpoint")
 
     booster = engine_train(
         dict(params), train_set, num_boost_round=config.num_iterations,
@@ -417,7 +427,7 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         verbose_eval=config.metric_freq if (valid_sets or
                                             config.is_provide_training_metric)
         else False,
-        callbacks=callbacks)
+        callbacks=callbacks, resume_from=resume_from)
     booster.save_model(config.output_model)
     log.info(f"Finished training, model saved to {config.output_model}")
 
@@ -450,9 +460,8 @@ def run_convert_model(config: Config, params: Dict[str, str]) -> None:
         log.fatal("No model file: set input_model=<file>")
     booster = Booster(model_file=config.input_model)
     from .io.codegen import model_to_if_else
-    code = model_to_if_else(booster._boosting)
-    with open(config.convert_model, "w") as fh:
-        fh.write(code)
+    from .utils.atomic_write import atomic_write_text
+    atomic_write_text(config.convert_model, model_to_if_else(booster._boosting))
     log.info(f"Converted model saved to {config.convert_model}")
 
 

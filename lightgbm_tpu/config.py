@@ -110,6 +110,7 @@ PARAM_ALIASES: Dict[str, str] = {
     "machine_list_file": "machine_list_filename", "machine_list": "machine_list_filename",
     "mlist": "machine_list_filename",
     "workers": "machines", "nodes": "machines",
+    "checkpoint_dir": "checkpoint_path", "ckpt_dir": "checkpoint_path",
 }
 
 # Objective aliases (reference: src/objective/objective_function.cpp + config.cpp ParseObjectiveAlias)
@@ -218,6 +219,27 @@ class Config:
     verbosity: int = 1
     snapshot_freq: int = -1
     linear_tree: bool = False
+    # fail fast on NaN/Inf gradients/hessians/leaf outputs, naming the
+    # iteration and offending count before they poison the histograms
+    # (disables the fused/lazy fast paths while on — a debugging guard rail)
+    check_numerics: bool = False
+
+    # Checkpointing
+    # directory for atomic training checkpoints ("" = <output_model>.ckpt
+    # when snapshot_freq > 0 in the CLI); see lightgbm_tpu/checkpoint.py
+    checkpoint_path: str = ""
+    # how many recent checkpoints to retain (>= 2 keeps a fallback when the
+    # newest is truncated/corrupt)
+    checkpoint_keep: int = 2
+
+    # Fault injection (testing)
+    # hard-exit (like SIGKILL) at the start of this 0-based iteration;
+    # see lightgbm_tpu/utils/faults.py
+    fault_kill_at_iter: int = -1
+    # overwrite leading gradient values with NaN at this 0-based iteration
+    fault_nan_grad_at_iter: int = -1
+    # flip bytes in each checkpoint's model text right after it is written
+    fault_corrupt_checkpoint: bool = False
 
     # IO / dataset (config.h:604-800)
     max_bin: int = 255

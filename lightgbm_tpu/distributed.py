@@ -135,7 +135,10 @@ def init(machines: Optional[str] = None,
          process_id: Optional[int] = None,
          coordinator_address: Optional[str] = None,
          params: Optional[dict] = None,
-         local_device_ids=None) -> None:
+         local_device_ids=None,
+         connect_retries: int = 5,
+         connect_backoff: float = 1.0,
+         connect_timeout: Optional[float] = None) -> None:
     """Initialize multi-host training (idempotent).
 
     Args:
@@ -147,9 +150,18 @@ def init(machines: Optional[str] = None,
         machine list (linkers_socket.cpp:38) or the JAX env autodetection.
       coordinator_address: overrides the coordinator (host:port).
       params: a params/config mapping — ``machines``/``num_machines``/
-        ``local_listen_port`` are read from it when the explicit args are
-        absent (so CLI configs written for the reference work unchanged).
+        ``local_listen_port``/``time_out`` are read from it when the
+        explicit args are absent (so CLI configs written for the reference
+        work unchanged).
       local_device_ids: forwarded to ``jax.distributed.initialize``.
+      connect_retries: attempts to reach the coordinator before giving up
+        (a slow-starting rank 0 must not fail the whole cluster — the
+        reference's socket linker retries its connect the same way,
+        linkers_socket.cpp TryBind/Connect loops).
+      connect_backoff: initial retry delay in seconds; doubles per attempt
+        (capped at 30s).
+      connect_timeout: overall deadline in seconds across retries
+        (defaults to the ``time_out`` parameter when given via params).
     """
     global _initialized
     if _initialized:
@@ -171,6 +183,9 @@ def init(machines: Optional[str] = None,
         num_machines = num_machines or int(get("num_machines") or 0) or None
         lp = get("local_listen_port")
         listen_port = int(lp) if lp else None
+        if connect_timeout is None:
+            to = get("time_out")
+            connect_timeout = float(to) if to else None
 
     mlist = [m.strip() for m in machines.split(",") if m.strip()] \
         if machines else []
@@ -195,10 +210,59 @@ def init(machines: Optional[str] = None,
         kwargs["process_id"] = process_id
     if local_device_ids is not None:
         kwargs["local_device_ids"] = local_device_ids
-    jax.distributed.initialize(**kwargs)
+    _initialize_with_backoff(kwargs, connect_retries, connect_backoff,
+                             connect_timeout)
     _initialized = True
     log.info(f"distributed: process {jax.process_index()} of "
              f"{jax.process_count()}, {len(jax.devices())} global devices")
+
+
+def _initialize_with_backoff(kwargs: dict, retries: int, backoff: float,
+                             timeout: Optional[float]) -> None:
+    """``jax.distributed.initialize`` under bounded exponential backoff: a
+    coordinator (rank 0) that is still starting up must not fail the
+    cluster; a coordinator that never comes up must fail with an error
+    naming the address that was unreachable."""
+    import time
+    import jax
+    attempts = max(1, int(retries))
+    delay = max(0.0, float(backoff))
+    deadline = (time.monotonic() + timeout) if timeout else None
+    for attempt in range(1, attempts + 1):
+        try:
+            jax.distributed.initialize(**kwargs)
+            return
+        except (ValueError, TypeError):
+            # configuration errors (malformed address, bad argument
+            # combinations) are permanent: fail fast, don't sleep on them
+            raise
+        except Exception as e:  # jax raises backend-specific error types
+            out_of_time = deadline is not None \
+                and time.monotonic() + delay > deadline
+            if attempt >= attempts or out_of_time:
+                addr = kwargs.get("coordinator_address") \
+                    or os.environ.get("JAX_COORDINATOR_ADDRESS") \
+                    or "<env-autodetected coordinator>"
+                log.fatal(
+                    f"could not connect to the distributed coordinator at "
+                    f"{addr} after {attempt} attempt(s)"
+                    + (f" within {timeout:g}s" if out_of_time else "")
+                    + f": {e}")
+            log.warning(f"coordinator connect attempt {attempt}/{attempts} "
+                        f"failed ({e}); retrying in {delay:.1f}s")
+            time.sleep(delay)
+            delay = min(max(delay, 0.1) * 2, 30.0)
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-process synchronization point (no-op single-process). Used by
+    the checkpoint writer so no rank races past a checkpoint another rank
+    may later resume from."""
+    import jax
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
 
 
 def shutdown() -> None:

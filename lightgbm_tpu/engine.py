@@ -37,8 +37,18 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
           early_stopping_rounds: Optional[int] = None,
           evals_result: Optional[dict] = None,
           verbose_eval="warn", learning_rates=None,
-          keep_training_booster: bool = False, callbacks=None) -> Booster:
-    """Train a booster (reference: engine.py:14-278)."""
+          keep_training_booster: bool = False, callbacks=None,
+          resume_from: Optional[str] = None) -> Booster:
+    """Train a booster (reference: engine.py:14-278).
+
+    ``resume_from``: a checkpoint directory written by the
+    ``callback.checkpoint`` callback — training restores the full trainer
+    state (trees, score caches, RNG/drop state, eval history, early-stop
+    counters) from the newest VALID checkpoint and continues at the saved
+    iteration, reproducing the uninterrupted run bit-identically; when the
+    directory holds no valid checkpoint, training starts from scratch with
+    a warning. Pass the same params/datasets/callbacks as the original run
+    (a params or dataset mismatch is rejected)."""
     params = copy.deepcopy(params)
     num_boost_round = _resolve_num_boost_round(params, num_boost_round)
     if fobj is not None:
@@ -134,8 +144,31 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                         key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted((c for c in cbs if not getattr(c, "before_iteration", False)),
                        key=lambda c: getattr(c, "order", 0))
+    # the checkpoint callback captures stateful-callback state through the
+    # booster (checkpoint.capture_state reads booster._callbacks)
+    booster._callbacks = cbs_before + cbs_after
 
-    for i in range(num_boost_round):
+    start_iter = 0
+    if resume_from is not None:
+        from . import checkpoint as checkpoint_mod
+        ckpt = checkpoint_mod.CheckpointManager(resume_from).load_latest_valid()
+        if ckpt is None:
+            log.warning(f"resume_from={resume_from!r}: no valid checkpoint "
+                        f"found; training from scratch")
+        else:
+            cb_states = checkpoint_mod.restore_booster(booster, ckpt)
+            start_iter = int(ckpt.state["boosting"]["iter"])
+            for cb in booster._callbacks:
+                key = getattr(cb, "ckpt_key", None)
+                if key in cb_states and hasattr(cb, "set_state"):
+                    cb.set_state(cb_states[key])
+            log.info(f"resumed from checkpoint {ckpt.path} at iteration "
+                     f"{start_iter}")
+
+    from .utils import faults
+    fault_plan = faults.plan_from(booster.config)
+    for i in range(start_iter, num_boost_round):
+        faults.maybe_kill(fault_plan, i)
         for cb in cbs_before:
             cb(CallbackEnv(model=booster, params=params, iteration=i,
                            begin_iteration=0, end_iteration=num_boost_round,

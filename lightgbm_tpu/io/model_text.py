@@ -164,9 +164,16 @@ class ModelTree:
 
     @classmethod
     def from_kv(cls, kv: Dict[str, str]) -> "ModelTree":
-        """Parse one tree block (reference: tree.cpp:653+ Tree(const char*))."""
+        """Parse one tree block (reference: tree.cpp:653+ Tree(const char*)).
+        Every section is validated for presence/length/parseability so a
+        truncated block raises a descriptive ValueError naming the section
+        instead of a bare KeyError/IndexError deep in numpy."""
         t = cls()
+        if "num_leaves" not in kv:
+            raise ValueError("missing 'num_leaves' section")
         t.num_leaves = int(kv["num_leaves"])
+        if t.num_leaves < 1:
+            raise ValueError(f"invalid num_leaves={t.num_leaves}")
         t.num_cat = int(kv.get("num_cat", "0"))
         n = t.num_leaves - 1
 
@@ -176,7 +183,14 @@ class ModelTree:
                 if default is not None:
                     return np.full(count, default, dtype)
                 return np.zeros(count, dtype)
-            return np.asarray(s.split(), dtype=dtype)
+            try:
+                out = np.asarray(s.split(), dtype=dtype)
+            except (ValueError, OverflowError) as e:
+                raise ValueError(f"unparseable '{key}' section: {e}")
+            if len(out) != count:
+                raise ValueError(f"'{key}' section has {len(out)} values, "
+                                 f"expected {count}")
+            return out
 
         t.split_feature = arr("split_feature", np.int32, n)
         t.split_gain = arr("split_gain", np.float64, n)
@@ -192,6 +206,8 @@ class ModelTree:
         t.internal_count = arr("internal_count", np.int64, n)
         if t.num_cat > 0:
             t.cat_boundaries = arr("cat_boundaries", np.int32, t.num_cat + 1)
+            if "cat_threshold" not in kv:
+                raise ValueError("missing 'cat_threshold' section")
             t.cat_threshold = np.asarray(kv["cat_threshold"].split(),
                                          dtype=np.uint64).astype(np.uint32)
         t.is_linear = bool(int(kv.get("is_linear", "0")))
@@ -200,6 +216,11 @@ class ModelTree:
             nf = arr("num_features", np.int32, t.num_leaves)
             feats = kv.get("leaf_features", "").split()
             coefs = kv.get("leaf_coeff", "").split()
+            total = int(np.sum(nf))
+            if len(feats) < total or len(coefs) < total:
+                raise ValueError(
+                    f"'leaf_features'/'leaf_coeff' sections hold "
+                    f"{len(feats)}/{len(coefs)} values, expected {total}")
             pos = 0
             for c in nf:
                 t.leaf_features.append([int(x) for x in feats[pos:pos + c]])
@@ -688,7 +709,12 @@ class LoadedGBDT:
 
 
 def load_model(model_str: str, config: Optional[Config] = None) -> LoadedGBDT:
-    """Parse a v3 model text (reference: gbdt_model_text.cpp:417-520)."""
+    """Parse a v3 model text (reference: gbdt_model_text.cpp:417-520).
+
+    Truncated or garbage input fails with a descriptive
+    "corrupt or truncated model file" error naming the tree block /
+    section / line — never a bare KeyError/IndexError that lets a
+    half-written file parse into a silently shorter model."""
     config = config or Config()
     lines = model_str.split("\n")
     kv: Dict[str, str] = {}
@@ -706,11 +732,14 @@ def load_model(model_str: str, config: Optional[Config] = None) -> LoadedGBDT:
         i += 1
 
     trees: List[ModelTree] = []
+    saw_end_of_trees = False
     while i < len(lines):
         line = lines[i].strip()
         if line == "end of trees":
+            saw_end_of_trees = True
             break
         if line.startswith("Tree="):
+            tree_line = i + 1          # 1-based line of the Tree= marker
             tkv: Dict[str, str] = {}
             i += 1
             while i < len(lines):
@@ -721,9 +750,20 @@ def load_model(model_str: str, config: Optional[Config] = None) -> LoadedGBDT:
                     key, val = tl.split("=", 1)
                     tkv[key] = val
                 i += 1
-            trees.append(ModelTree.from_kv(tkv))
+            try:
+                trees.append(ModelTree.from_kv(tkv))
+            except (KeyError, IndexError, ValueError, OverflowError) as e:
+                msg = f"missing {e} section" if isinstance(e, KeyError) \
+                    else str(e)
+                log.fatal(f"corrupt or truncated model file: tree block "
+                          f"{len(trees)} (line {tree_line}): {msg}")
         else:
             i += 1
+    if not saw_end_of_trees:
+        log.fatal(f"corrupt or truncated model file: missing the "
+                  f"'end of trees' sentinel (input ends at line "
+                  f"{len(lines)} after {len(trees)} complete tree blocks "
+                  f"— a partial write?)")
 
     # parameters block (gbdt_model_text.cpp:507-516 loaded_parameter_)
     params: Dict[str, str] = {}
@@ -747,23 +787,26 @@ def load_model(model_str: str, config: Optional[Config] = None) -> LoadedGBDT:
             except (ValueError, TypeError):
                 pass
 
-    if "objective" in kv:
-        _parse_objective(kv["objective"], config)
-    if "num_class" in kv:
-        config.num_class = int(kv["num_class"])
+    try:
+        if "objective" in kv:
+            _parse_objective(kv["objective"], config)
+        if "num_class" in kv:
+            config.num_class = int(kv["num_class"])
 
-    meta = {
-        "num_class": int(kv.get("num_class", "1")),
-        "num_tree_per_iteration": int(kv.get("num_tree_per_iteration", "1")),
-        "label_index": int(kv.get("label_index", "0")),
-        "max_feature_idx": int(kv.get("max_feature_idx", "0")),
-        "objective": kv.get("objective"),
-        "average_output": "average_output" in kv,
-        "feature_names": kv.get("feature_names", "").split(),
-        "monotone_constraints": [int(x) for x in
-                                 kv.get("monotone_constraints", "").split()],
-        "feature_infos": kv.get("feature_infos", "").split(),
-        "parameters": params,
-        "pandas_categorical": pandas_categorical,
-    }
+        meta = {
+            "num_class": int(kv.get("num_class", "1")),
+            "num_tree_per_iteration": int(kv.get("num_tree_per_iteration", "1")),
+            "label_index": int(kv.get("label_index", "0")),
+            "max_feature_idx": int(kv.get("max_feature_idx", "0")),
+            "objective": kv.get("objective"),
+            "average_output": "average_output" in kv,
+            "feature_names": kv.get("feature_names", "").split(),
+            "monotone_constraints": [int(x) for x in
+                                     kv.get("monotone_constraints", "").split()],
+            "feature_infos": kv.get("feature_infos", "").split(),
+            "parameters": params,
+            "pandas_categorical": pandas_categorical,
+        }
+    except (ValueError, OverflowError) as e:
+        log.fatal(f"corrupt or truncated model file: header: {e}")
     return LoadedGBDT(meta, trees, config)

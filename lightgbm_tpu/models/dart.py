@@ -61,6 +61,24 @@ class DART(GBDT):
         self._drop_rng = np.random.RandomState(config.drop_seed)
         self.sum_weight = sum(self.tree_weight)
 
+    # ------------------------------------------------- checkpoint/resume
+    def get_trainer_state(self) -> dict:
+        """DART adds the drop RNG's full numpy state and the per-iteration
+        tree weights (dart.hpp:201) — without them a resume would draw a
+        DIFFERENT drop set and silently train a different model."""
+        state = super().get_trainer_state()
+        state["dart"] = {"drop_rng_state": self._drop_rng.get_state(),
+                         "tree_weight": list(self.tree_weight),
+                         "sum_weight": float(self.sum_weight)}
+        return state
+
+    def set_trainer_state(self, state: dict) -> None:
+        super().set_trainer_state(state)
+        d = state["dart"]
+        self._drop_rng.set_state(d["drop_rng_state"])
+        self.tree_weight = list(d["tree_weight"])
+        self.sum_weight = float(d["sum_weight"])
+
     # ------------------------------------------------------------- drop
     def _select_drop_iters(self) -> List[int]:
         """reference: dart.hpp:97-134 DroppingTrees (selection part)."""

@@ -206,17 +206,23 @@ class GBDT:
         return (self._supports_lazy_host
                 and self.iter >= 1
                 and not self.config.linear_tree
+                # check_numerics inspects each tree's leaf outputs in
+                # _finalize_tree, which the lazy path skips
+                and not self.config.check_numerics
                 and not (self.objective is not None
                          and self.objective.need_renew_tree_output))
 
     _supports_lazy_host = True   # DART/RF override: they touch host trees
     _rows_streamed_dev = 0.0     # overwritten per-train; float for loaded
                                  # boosters that never trained here
+    _fault_plan = None           # set per-train (utils/faults injection)
 
     # ------------------------------------------------------------ setup
     def _init_train(self, train_set: Dataset) -> None:
+        from ..utils import faults
         train_set.construct()
         cfg = self.config
+        self._fault_plan = faults.plan_from(cfg)
         # pre-partitioned mode (distributed.load_partitioned): bins are a
         # global row-sharded array; labels/weights/scores/gradients stay
         # PROCESS-LOCAL (the reference's per-machine score partition,
@@ -601,6 +607,11 @@ class GBDT:
         cfg = self.config
         return (type(self) is GBDT
                 and grad_external is None
+                # numerics checks and NaN-gradient injection both need the
+                # gradients materialized outside the fused program
+                and not cfg.check_numerics
+                and (self._fault_plan is None
+                     or not self._fault_plan.wants_nan_grad)
                 and self.num_tree_per_iteration == 1
                 and self._parallel_grower is None
                 and self.objective is not None
@@ -723,6 +734,11 @@ class GBDT:
             else:
                 g = jnp.asarray(np.asarray(grad, dtype=np.float32).reshape(self._score_shape))
                 h = jnp.asarray(np.asarray(hess, dtype=np.float32).reshape(self._score_shape))
+        if self._fault_plan is not None:
+            from ..utils import faults
+            g, h = faults.maybe_nan_grad(self._fault_plan, self.iter, g, h)
+        if cfg.check_numerics:
+            self._check_numerics_grad(g, h)
         sample_weights = self._sample_weights(g, h)
         if sample_weights is not None:
             # GOSS-style reweighting: grad/hess amplified, the 0/1 mask keeps
@@ -1013,6 +1029,32 @@ class GBDT:
         """Hook for GOSS-style reweighted sampling; None = use bag mask."""
         return None
 
+    # ---------------------------------------------------- numerics guard
+    def _check_numerics_grad(self, g: jax.Array, h: jax.Array) -> None:
+        """check_numerics fail-fast: NaN/Inf gradients or hessians poison
+        every histogram they touch and surface much later as garbage
+        splits — name the iteration and offending count NOW instead."""
+        bad_g = int(jnp.sum(~jnp.isfinite(g)))
+        bad_h = int(jnp.sum(~jnp.isfinite(h)))
+        if bad_g or bad_h:
+            log.fatal(
+                f"check_numerics: iteration {self.iter}: {bad_g} non-finite "
+                f"gradient and {bad_h} non-finite hessian values out of "
+                f"{int(np.prod(g.shape))} — failing fast before they poison "
+                f"the histograms (check the objective / custom fobj, "
+                f"learning_rate, and input features)")
+
+    def _check_numerics_leaves(self, t_host, num_leaves: int) -> None:
+        """check_numerics on a finalized tree's leaf outputs."""
+        lv = np.asarray(t_host.leaf_value[:max(num_leaves, 1)])
+        bad = int(np.sum(~np.isfinite(lv)))
+        if bad:
+            log.fatal(
+                f"check_numerics: iteration {self.iter}: {bad} of "
+                f"{max(num_leaves, 1)} leaf outputs in the new tree are "
+                f"non-finite — failing fast before the score caches are "
+                f"poisoned")
+
     def _record_rows_streamed(self, rows_streamed: jax.Array) -> None:
         """Accumulate a tree's histogram-pass row count (device add, no
         sync); mirror into the profiling counters when TIMETAG is on (the
@@ -1056,6 +1098,8 @@ class GBDT:
         lr = self.shrinkage_rate
         tree = _shrink_tree(tree, lr)
         t_host = _shrink_tree(t_host, lr)
+        if cfg.check_numerics:
+            self._check_numerics_leaves(t_host, num_leaves)
         return tree, t_host, had_split
 
     def _renew_score(self, class_idx: int) -> np.ndarray:
@@ -1366,6 +1410,105 @@ class GBDT:
                     self._valid_scores[i] = self._valid_scores[i] - vdelta
         self.iter -= 1
         self._stacked_cache = None
+
+    # ------------------------------------------------- checkpoint/resume
+    def get_trainer_state(self) -> dict:
+        """Complete trainer state for checkpointing (see
+        lightgbm_tpu/checkpoint.py): everything a resume needs to continue
+        BIT-IDENTICALLY — the exact float32 score caches, device tree
+        arrays, host mirrors and the stateful RNGs. Device-PRNG draws
+        (bagging, GOSS, extra_trees) are fold_in(seed, iter) and need no
+        state; the numpy RNGs (feature fraction; DART's drop RNG in the
+        subclass) are stateful and serialize their full state."""
+        self._flush_pending()
+        state = {
+            "name": self.name,
+            "iter": int(self.iter),
+            "trees": jax.device_get(self.trees),
+            "host_trees": list(self._host_trees),
+            "tree_bias": list(self.tree_bias),
+            "init_scores": list(self.init_scores),
+            "train_score": (np.asarray(self.train_score)
+                            if self.train_score is not None else None),
+            "valid_scores": [np.asarray(s) for s in self._valid_scores],
+            "feat_rng_state": self._feat_rng.get_state(),
+            "splitless_group": self._splitless_group,
+            "splitless_in_group": self._splitless_in_group,
+            "lagged_stop": self._lagged_stop,
+            "rows_streamed": float(self._rows_streamed_dev),
+            "best_score": dict(self.best_score),
+            # the measured-auto histogram method is timing-dependent: the
+            # resumed process must reuse the original run's choice or the
+            # compiled program (and float accumulation order) could differ
+            "measured_hm": getattr(self, "_measured_hm", None),
+            "cegb_aux": (jax.device_get(self._cegb_aux)
+                         if self._cegb_aux is not None else None),
+            "loaded_iters": self.loaded_iters,
+            "loaded_model_text": None,
+        }
+        if self.loaded is not None:
+            from ..io.model_text import dump_model_text
+            state["loaded_model_text"] = dump_model_text(self.loaded)
+        return state
+
+    def set_trainer_state(self, state: dict) -> None:
+        """Inverse of :meth:`get_trainer_state`, applied to a freshly
+        constructed booster over the same dataset/params."""
+        if state.get("name") != self.name:
+            log.fatal(f"checkpoint was written by "
+                      f"boosting={state.get('name')!r}; this booster is "
+                      f"boosting={self.name!r}")
+        if len(state["valid_scores"]) != len(self._valid_scores):
+            log.fatal(f"checkpoint was written with "
+                      f"{len(state['valid_scores'])} validation sets; this "
+                      f"run has {len(self._valid_scores)} — pass the same "
+                      f"valid_sets in the same order")
+        self.iter = int(state["iter"])
+        self.trees = [jax.tree.map(jnp.asarray, t) for t in state["trees"]]
+        self._host_trees = list(state["host_trees"])
+        self._pending_host = []
+        self.tree_bias = list(state["tree_bias"])
+        self.init_scores = list(state["init_scores"])
+        if state["train_score"] is not None:
+            self.train_score = jnp.asarray(state["train_score"])
+        self._valid_scores = [jnp.asarray(s) for s in state["valid_scores"]]
+        self._feat_rng.set_state(state["feat_rng_state"])
+        self._splitless_group = state["splitless_group"]
+        self._splitless_in_group = state["splitless_in_group"]
+        self._lagged_stop = state["lagged_stop"]
+        self._rows_streamed_dev = jnp.float32(state["rows_streamed"])
+        self.best_score = dict(state["best_score"])
+        if state.get("measured_hm") is not None:
+            self._measured_hm = state["measured_hm"]
+        if state.get("cegb_aux") is not None:
+            self._cegb_aux = jax.tree.map(jnp.asarray, state["cegb_aux"])
+        if state.get("loaded_model_text"):
+            from ..io.model_text import load_model
+            self.loaded = load_model(state["loaded_model_text"], self.config)
+            self.loaded_iters = int(state["loaded_iters"])
+        self._stacked_cache = None
+        self._mt_cache.clear()
+        self._contrib_tree_cache = None
+        self._bag_frac = None
+        self._restore_bagging()
+
+    def _restore_bagging(self) -> None:
+        """Recreate the bagging mask/subset active at the restored
+        iteration: a mask drawn at the last refresh iteration persists
+        across the whole bagging period, so a mid-period resume re-draws
+        it from the same fold_in(refresh_iter) key (the draw is
+        deterministic in the iteration — no RNG state to persist)."""
+        cfg = self.config
+        if not self._need_bagging or cfg.bagging_freq <= 0 or self.iter <= 0:
+            return
+        if self.iter % cfg.bagging_freq == 0:
+            return   # the next iteration re-draws anyway
+        saved = self.iter
+        try:
+            self.iter = (saved // cfg.bagging_freq) * cfg.bagging_freq
+            self._update_bagging()
+        finally:
+            self.iter = saved
 
     # ------------------------------------------------------------- eval
     def eval_set(self, feval=None) -> List[Tuple[str, str, float, bool]]:

@@ -95,6 +95,24 @@ class GOSS(GBDT):
         log.info("Using GOSS")
         super().__init__(config, train_set, objective)
 
+    # ------------------------------------------------- checkpoint/resume
+    def get_trainer_state(self) -> dict:
+        """GOSS adds nothing stateful to the base checkpoint: its sampling
+        key is ``fold_in(PRNGKey(bagging_seed), iter)`` — fully determined
+        by the restored iteration — and the 1/learning_rate warm-up gate
+        depends only on ``iter``. The seed is recorded anyway so a
+        tampered sidecar can't silently resample."""
+        state = super().get_trainer_state()
+        state["goss"] = {"bagging_seed": int(self.config.bagging_seed)}
+        return state
+
+    def set_trainer_state(self, state: dict) -> None:
+        super().set_trainer_state(state)
+        seed = state.get("goss", {}).get("bagging_seed")
+        if seed is not None and int(seed) != int(self.config.bagging_seed):
+            log.fatal(f"checkpoint GOSS bagging_seed {seed} does not match "
+                      f"this run's {self.config.bagging_seed}")
+
     def _sample_weights(self, g, h) -> Optional[jax.Array]:
         """reference: goss.hpp:105-150 BaggingHelper — selection, weights
         and RNG all stay on device (no per-iteration host round trip)."""

@@ -1,0 +1,131 @@
+"""Fault-injection harness for resilience testing.
+
+Deterministic, opt-in failure points threaded through the training loop so
+the fault-tolerance suite (tests/test_fault_tolerance.py) can exercise the
+checkpoint/resume and numerics guard-rail machinery against REAL failure
+shapes — a hard kill mid-run (preemptible TPU fleets), a checkpoint
+truncated/corrupted on disk, and NaN gradients poisoning histograms —
+instead of only happy paths.
+
+Faults are driven by params (``fault_kill_at_iter`` etc. on Config) or
+environment variables (which override params, so a test can arm a fault in
+a child process without touching its config):
+
+  LGBM_TPU_FAULT_KILL_AT_ITER=k       hard-exit (os._exit(137), no cleanup,
+                                      like SIGKILL) at the START of 0-based
+                                      boosting iteration k
+  LGBM_TPU_FAULT_NAN_GRAD_AT_ITER=k   overwrite the first
+                                      LGBM_TPU_FAULT_NAN_GRAD_COUNT (default
+                                      8) gradient values with NaN at
+                                      iteration k
+  LGBM_TPU_FAULT_CORRUPT_CHECKPOINT=1 flip bytes in every checkpoint's
+                                      model text right after it is written
+                                      (simulates on-disk corruption)
+
+With no fault armed the plan is ``None`` and every hook is a single
+attribute check — zero cost on the training path.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+_KILL_EXIT_CODE = 137   # 128 + SIGKILL: what a preemption/oom kill reports
+
+
+@dataclass
+class FaultPlan:
+    kill_at_iter: int = -1
+    nan_grad_at_iter: int = -1
+    nan_grad_count: int = 8
+    corrupt_checkpoint: bool = False
+
+    @property
+    def wants_nan_grad(self) -> bool:
+        return self.nan_grad_at_iter >= 0
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "")
+    try:
+        return int(v) if v != "" else default
+    except ValueError:
+        return default
+
+
+def plan_from(config=None) -> Optional[FaultPlan]:
+    """Build the active fault plan from config fields overridden by the
+    LGBM_TPU_FAULT_* environment; None when nothing is armed."""
+    get = (lambda k, d: getattr(config, k, d)) if config is not None \
+        else (lambda k, d: d)
+    plan = FaultPlan(
+        kill_at_iter=_env_int("LGBM_TPU_FAULT_KILL_AT_ITER",
+                              int(get("fault_kill_at_iter", -1))),
+        nan_grad_at_iter=_env_int("LGBM_TPU_FAULT_NAN_GRAD_AT_ITER",
+                                  int(get("fault_nan_grad_at_iter", -1))),
+        nan_grad_count=_env_int("LGBM_TPU_FAULT_NAN_GRAD_COUNT", 8),
+        corrupt_checkpoint=(
+            # env, when set, OVERRIDES the param (in both directions, like
+            # the integer faults): "1" arms, anything else disarms
+            os.environ["LGBM_TPU_FAULT_CORRUPT_CHECKPOINT"] == "1"
+            if "LGBM_TPU_FAULT_CORRUPT_CHECKPOINT" in os.environ
+            else bool(get("fault_corrupt_checkpoint", False))),
+    )
+    if (plan.kill_at_iter < 0 and plan.nan_grad_at_iter < 0
+            and not plan.corrupt_checkpoint):
+        return None
+    return plan
+
+
+def maybe_kill(plan: Optional[FaultPlan], iteration: int) -> None:
+    """Hard-exit at the armed iteration — ``os._exit`` skips atexit/finally
+    so nothing gets the chance to 'finish' a write (the SIGKILL shape a
+    preempted worker actually sees)."""
+    if plan is not None and plan.kill_at_iter == iteration:
+        sys.stderr.write(
+            f"[faults] killing process at iteration {iteration}\n")
+        sys.stderr.flush()
+        os._exit(_KILL_EXIT_CODE)
+
+
+def maybe_nan_grad(plan: Optional[FaultPlan], iteration: int, g, h):
+    """Overwrite the first ``nan_grad_count`` gradient entries with NaN at
+    the armed iteration (returns possibly-modified (g, h))."""
+    if plan is None or plan.nan_grad_at_iter != iteration:
+        return g, h
+    import jax.numpy as jnp
+    n = min(plan.nan_grad_count, g.shape[0])
+    flat = g.reshape(-1)
+    flat = flat.at[:n].set(jnp.nan)
+    return flat.reshape(g.shape), h
+
+
+def corrupt_file(path: str, offset: Optional[int] = None,
+                 nbytes: int = 16, truncate: bool = False) -> None:
+    """Damage a file in place: XOR-flip ``nbytes`` at ``offset`` (middle of
+    the file by default), or truncate it there. Shared by the
+    corrupt-checkpoint injection point and the tests."""
+    size = os.path.getsize(path)
+    if offset is None:
+        offset = size // 2
+    offset = max(0, min(offset, max(size - 1, 0)))
+    if truncate:
+        with open(path, "r+b") as fh:
+            fh.truncate(offset)
+        return
+    with open(path, "r+b") as fh:
+        fh.seek(offset)
+        chunk = fh.read(nbytes)
+        fh.seek(offset)
+        fh.write(bytes(b ^ 0xA5 for b in chunk))
+
+
+def maybe_corrupt_checkpoint(plan: Optional[FaultPlan], path: str) -> None:
+    """Corruption injection point the checkpoint writer calls after a
+    successful save (damages the payload but leaves the manifest intact,
+    so only checksum validation can catch it)."""
+    if plan is not None and plan.corrupt_checkpoint:
+        corrupt_file(path)

@@ -96,14 +96,27 @@ def test_cli_save_binary_round_trip(workdir):
 
 
 def test_cli_snapshot(workdir):
+    # snapshot_freq now rides the atomic checkpoint subsystem: manifest-
+    # validated ckpt_N directories under <output_model>.ckpt instead of
+    # in-place .snapshot_iter_N dumps
     os.chdir(workdir)
     cli_main(["task=train", "objective=binary", "data=binary.train",
               "num_trees=6", "snapshot_freq=2", "output_model=snap.txt",
               "verbosity=-1"])
-    assert os.path.exists("snap.txt.snapshot_iter_2")
-    assert os.path.exists("snap.txt.snapshot_iter_4")
-    snap = lgb.Booster(model_file="snap.txt.snapshot_iter_4")
-    assert snap.num_trees() == 4
+    from lightgbm_tpu.checkpoint import CheckpointManager
+    mgr = CheckpointManager("snap.txt.ckpt")
+    # keep=2 (checkpoint_keep default) retains the two newest checkpoints
+    assert [it for it, _ in mgr.checkpoints()] == [4, 6]
+    ck = mgr.load_latest_valid()
+    assert ck.iteration == 6
+    snap = lgb.Booster(model_str=ck.model_text)
+    assert snap.num_trees() == 6
+    # rerunning the same command auto-resumes from the checkpoint (nothing
+    # left to train) and still writes the final model
+    cli_main(["task=train", "objective=binary", "data=binary.train",
+              "num_trees=6", "snapshot_freq=2", "output_model=snap.txt",
+              "verbosity=-1"])
+    assert lgb.Booster(model_file="snap.txt").num_trees() == 6
 
 
 def test_refit_improves_on_shifted_labels(workdir):

@@ -1,0 +1,444 @@
+"""Fault-tolerance suite: atomic writes, checkpoint/resume bit-parity,
+corruption fallback, numerics guard rails and the fault-injection harness
+(utils/faults.py). The headline assertions implement the acceptance bar:
+kill at iteration k + resume == the uninterrupted run, byte for byte, for
+gbdt/dart/goss with bagging; and a corrupted latest checkpoint falls back
+to the previous valid one with a clear warning.
+
+Everything here runs on synthetic data (no /root/reference dependency).
+Fast knobs run in tier-1; the real kill/respawn subprocess case is
+additionally marked slow."""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.checkpoint import (CheckpointManager, dataset_fingerprint,
+                                     params_hash)
+from lightgbm_tpu.io.model_text import load_model
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.atomic_write import atomic_write_text
+from lightgbm_tpu.utils.log import LightGBMError
+
+pytestmark = pytest.mark.faults
+
+N, F = 400, 10
+
+
+def _data(seed=0, binary=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(N, F)
+    if binary:
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    else:
+        y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.randn(N)
+    return X, y
+
+
+MODE_PARAMS = {
+    "gbdt": {"objective": "regression", "bagging_fraction": 0.6,
+             "bagging_freq": 2, "feature_fraction": 0.8},
+    # fraction <= 0.5 takes the bagging-subset copy path; the kill at an
+    # odd iteration lands mid-bagging-period, so resume must re-derive
+    # the persisted subset from the last refresh iteration's key
+    "gbdt_subset": {"objective": "regression", "bagging_fraction": 0.4,
+                    "bagging_freq": 2, "feature_fraction": 0.8},
+    "dart": {"objective": "regression", "boosting": "dart",
+             "drop_rate": 0.5, "skip_drop": 0.3, "bagging_fraction": 0.6,
+             "bagging_freq": 2, "feature_fraction": 0.8},
+    # GOSS rejects bagging by design (goss.hpp); its own sampling is the
+    # stochastic state under test (learning_rate 0.5 ends the warm-up
+    # window after 2 iterations so sampling is live across the resume)
+    "goss": {"objective": "regression", "boosting": "goss",
+             "top_rate": 0.3, "other_rate": 0.2, "learning_rate": 0.5,
+             "feature_fraction": 0.8},
+}
+BASE = {"num_leaves": 7, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def _train(params, X, y, rounds, **kw):
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    return lgb.train(dict(params), ds, num_boost_round=rounds, **kw)
+
+
+# ===================================================== utils/atomic_write
+def test_atomic_write_creates_and_replaces(tmp_path):
+    p = tmp_path / "out.txt"
+    atomic_write_text(str(p), "first")
+    assert p.read_text() == "first"
+    atomic_write_text(str(p), "second")
+    assert p.read_text() == "second"
+    # no tmp droppings left behind
+    assert os.listdir(tmp_path) == ["out.txt"]
+
+
+def test_save_model_is_atomic_and_loadable(tmp_path):
+    X, y = _data()
+    booster = _train({**BASE, "objective": "regression"}, X, y, 3)
+    out = tmp_path / "model.txt"
+    booster.save_model(str(out))
+    assert os.listdir(tmp_path) == ["model.txt"]   # no tmp leftovers
+    again = lgb.Booster(model_file=str(out))
+    assert again.num_trees() == 3
+
+
+# ==================================================== corrupt model files
+def test_load_model_truncations_raise_clear_error(tmp_path):
+    X, y = _data(binary=True)
+    text = _train({**BASE, "objective": "binary"}, X, y, 4).model_to_string()
+    cap = text.index("end of trees")
+    for frac in (0.2, 0.4, 0.6, 0.8, 0.95):
+        truncated = text[:int(cap * frac)]
+        try:
+            load_model(truncated)
+        except LightGBMError as e:
+            assert "corrupt or truncated model file" in str(e), str(e)
+        else:
+            pytest.fail(f"truncation at {frac:.0%} of the tree region "
+                        f"parsed without error")
+
+
+def test_load_model_garbage_and_bitflips(tmp_path):
+    with pytest.raises(LightGBMError, match="corrupt or truncated"):
+        load_model("hello world\nthis is not a model\n")
+    X, y = _data()
+    text = _train({**BASE, "objective": "regression"}, X, y, 3).model_to_string()
+    # damage a tree block's num_leaves so section lengths disagree
+    bad = text.replace("num_leaves=7", "num_leaves=5", 1)
+    if bad != text:
+        with pytest.raises(LightGBMError, match="corrupt or truncated"):
+            load_model(bad)
+
+
+# ============================================ checkpoint/resume bit-parity
+@pytest.mark.parametrize("mode", ["gbdt", "gbdt_subset", "dart", "goss"])
+def test_kill_resume_bit_identical(mode, tmp_path):
+    """The acceptance bar: training interrupted at iteration k resumes to
+    a final model text byte-identical to the uninterrupted run's. k=5 is
+    deliberately MID bagging period (bagging_freq=2), so the resume must
+    reconstruct the mask/subset drawn at iteration 4."""
+    X, y = _data()
+    params = {**BASE, **MODE_PARAMS[mode]}
+    full = _train(params, X, y, 10).model_to_string()
+    ckdir = str(tmp_path / "ck")
+    # "kill" after iteration 5: run 5 rounds with per-iteration checkpoints
+    _train(params, X, y, 5,
+           callbacks=[lgb.checkpoint_callback(ckdir, period=1)])
+    resumed = _train(params, X, y, 10, resume_from=ckdir,
+                     callbacks=[lgb.checkpoint_callback(ckdir, period=1)])
+    assert resumed.model_to_string() == full
+    assert resumed.current_iteration() == 10
+
+
+def test_corrupt_latest_falls_back_to_previous_valid(tmp_path, caplog):
+    X, y = _data()
+    params = {**BASE, "objective": "regression", "bagging_fraction": 0.6,
+              "bagging_freq": 2}
+    full = _train(params, X, y, 10).model_to_string()
+    ckdir = str(tmp_path / "ck")
+    _train(params, X, y, 6,
+           callbacks=[lgb.checkpoint_callback(ckdir, period=3)])
+    mgr = CheckpointManager(ckdir)
+    assert [it for it, _ in mgr.checkpoints()] == [3, 6]
+    faults.corrupt_file(os.path.join(ckdir, "ckpt_00000006", "model.txt"))
+    import logging
+    import lightgbm_tpu.utils.log as _log
+    logger = logging.getLogger("lgbm_tpu_test_ckpt")
+    lgb.register_logger(logger)
+    _log.set_verbosity(0)   # the trainings above set the global level to -1
+    try:
+        with caplog.at_level(logging.WARNING, logger=logger.name):
+            ck = mgr.load_latest_valid()
+            assert ck.iteration == 3
+        assert any("corrupt or truncated" in r.message for r in caplog.records)
+    finally:
+        _log._logger = None
+    resumed = _train(params, X, y, 10, resume_from=ckdir,
+                     callbacks=[lgb.checkpoint_callback(ckdir, period=3)])
+    assert resumed.model_to_string() == full
+
+
+def test_truncated_state_and_manifest_fall_back(tmp_path):
+    X, y = _data()
+    params = {**BASE, "objective": "regression"}
+    ckdir = str(tmp_path / "ck")
+    _train(params, X, y, 6,
+           callbacks=[lgb.checkpoint_callback(ckdir, period=3)])
+    # truncated sidecar: length check catches it
+    faults.corrupt_file(os.path.join(ckdir, "ckpt_00000006", "state.pkl"),
+                        truncate=True)
+    assert CheckpointManager(ckdir).load_latest_valid().iteration == 3
+    # unparseable manifest on the remaining one: no valid checkpoint left
+    faults.corrupt_file(os.path.join(ckdir, "ckpt_00000003",
+                                     "MANIFEST.json"), truncate=True)
+    assert CheckpointManager(ckdir).load_latest_valid() is None
+    # resume_from with nothing valid trains from scratch (with a warning)
+    full = _train(params, X, y, 4).model_to_string()
+    scratch = _train(params, X, y, 4, resume_from=ckdir)
+    assert scratch.model_to_string() == full
+
+
+def test_resume_rejects_params_and_dataset_mismatch(tmp_path):
+    X, y = _data()
+    params = {**BASE, "objective": "regression"}
+    ckdir = str(tmp_path / "ck")
+    _train(params, X, y, 4,
+           callbacks=[lgb.checkpoint_callback(ckdir, period=2)])
+    with pytest.raises(LightGBMError, match="different training parameters"):
+        _train({**params, "num_leaves": 15}, X, y, 8, resume_from=ckdir)
+    X2, y2 = _data(seed=7)
+    with pytest.raises(LightGBMError, match="different training dataset"):
+        _train(params, X2, y2, 8, resume_from=ckdir)
+
+
+def test_resume_restores_eval_history_and_early_stopping(tmp_path):
+    X, y = _data(binary=True)
+    Xv, yv = _data(seed=5, binary=True)
+    params = {**BASE, "objective": "binary", "metric": "binary_logloss"}
+
+    def run(rounds, resume_from=None, ckdir=None):
+        ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+        vs = lgb.Dataset(Xv, label=yv, params=params, reference=ds,
+                         free_raw_data=False)
+        hist = {}
+        cbs = [lgb.checkpoint_callback(ckdir, period=1)] if ckdir else []
+        booster = lgb.train(dict(params), ds, num_boost_round=rounds,
+                            valid_sets=[vs], valid_names=["v"],
+                            early_stopping_rounds=50, evals_result=hist,
+                            verbose_eval=False, callbacks=cbs,
+                            resume_from=resume_from)
+        return booster, hist
+
+    full, full_hist = run(8)
+    ckdir = str(tmp_path / "ck")
+    run(5, ckdir=ckdir)
+    resumed, resumed_hist = run(8, resume_from=ckdir, ckdir=ckdir)
+    # eval history continues seamlessly across the resume, and the
+    # early-stopping outcome (best iteration tracking) is unchanged
+    assert resumed_hist == full_hist
+    assert len(resumed_hist["v"]["binary_logloss"]) == 8
+    assert resumed.best_iteration == full.best_iteration
+    assert resumed.best_score == full.best_score
+
+
+# ======================================================== numerics guards
+def test_check_numerics_names_iteration_and_count():
+    X, y = _data()
+    params = {**BASE, "objective": "regression", "check_numerics": True,
+              "fault_nan_grad_at_iter": 2}
+    with pytest.raises(LightGBMError, match=r"iteration 2.*non-finite"):
+        _train(params, X, y, 6)
+
+
+def test_check_numerics_catches_custom_fobj_nans():
+    X, y = _data()
+    params = {**BASE, "objective": "regression", "check_numerics": True}
+
+    def bad_fobj(preds, ds):
+        g = preds - np.asarray(ds.get_label())
+        g[:3] = np.nan
+        return g, np.ones_like(g)
+
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    with pytest.raises(LightGBMError, match="3 non-finite gradient"):
+        lgb.train(dict(params), ds, num_boost_round=3, fobj=bad_fobj)
+
+
+def test_check_numerics_clean_run_unaffected():
+    X, y = _data()
+    base = {**BASE, "objective": "regression"}
+    plain = _train(base, X, y, 5).model_to_string()
+    checked = _train({**base, "check_numerics": True}, X, y, 5).model_to_string()
+    # the guard rail must not change the model: trees identical, only the
+    # echoed parameters block records the flag
+    assert plain.split("\nparameters:")[0] == checked.split("\nparameters:")[0]
+
+
+def test_nan_injection_env_overrides(monkeypatch):
+    X, y = _data()
+    monkeypatch.setenv("LGBM_TPU_FAULT_NAN_GRAD_AT_ITER", "1")
+    monkeypatch.setenv("LGBM_TPU_FAULT_NAN_GRAD_COUNT", "5")
+    params = {**BASE, "objective": "regression", "check_numerics": True}
+    with pytest.raises(LightGBMError, match=r"iteration 1.*5 non-finite"):
+        _train(params, X, y, 4)
+
+
+def test_corrupt_checkpoint_injection_point(tmp_path):
+    X, y = _data()
+    ckdir = str(tmp_path / "ck")
+    params = {**BASE, "objective": "regression",
+              "fault_corrupt_checkpoint": True}
+    _train(params, X, y, 4,
+           callbacks=[lgb.checkpoint_callback(ckdir, period=2)])
+    # every checkpoint was damaged post-write: none validates
+    assert CheckpointManager(ckdir).load_latest_valid() is None
+
+
+# ================================================== init_model continuity
+def test_init_model_continuation_parity(tmp_path):
+    """Satellite of the bit-identical criterion on the init_model path:
+    train(10) vs train(5) -> save -> resume(5) via init_model. The loaded
+    trees re-serialize byte-identically; the continued trees see a score
+    cache rebuilt from a float64 host prediction sum (vs the uninterrupted
+    run's sequential float32 adds), so the comparison here is tight
+    numerical equality of predictions, not text equality — the exact-text
+    bar is the checkpoint path's (test_kill_resume_bit_identical)."""
+    X, y = _data()
+    params = {**BASE, "objective": "regression"}
+    full = _train(params, X, y, 10)
+    part = _train(params, X, y, 5)
+    path = str(tmp_path / "part.txt")
+    part.save_model(path)
+    ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+    cont = lgb.train(dict(params), ds, num_boost_round=5, init_model=path)
+    assert cont.num_trees() == full.num_trees() == 10
+    # the first 5 tree blocks are the saved model's, byte for byte
+    full_blocks = full.model_to_string().split("Tree=")[1:6]
+    cont_blocks = cont.model_to_string().split("Tree=")[1:6]
+    assert cont_blocks == full_blocks
+    np.testing.assert_allclose(cont.predict(X), full.predict(X),
+                               rtol=1e-5, atol=1e-7)
+
+
+# ============================================== distributed init backoff
+def test_distributed_init_retries_then_succeeds(monkeypatch):
+    from lightgbm_tpu import distributed
+    import jax
+    calls = {"n": 0}
+
+    def flaky(**kwargs):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("coordinator not up yet")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    monkeypatch.setattr(distributed, "_jax_already_initialized",
+                        lambda: False)
+    try:
+        distributed.init(machines="127.0.0.1:45999", num_machines=1,
+                         connect_retries=5, connect_backoff=0.01)
+        assert calls["n"] == 3
+    finally:
+        distributed._initialized = False
+
+
+def test_distributed_init_failure_names_coordinator(monkeypatch):
+    from lightgbm_tpu import distributed
+    import jax
+
+    def always_down(**kwargs):
+        raise RuntimeError("connection refused")
+
+    monkeypatch.setattr(jax.distributed, "initialize", always_down)
+    monkeypatch.setattr(distributed, "_jax_already_initialized",
+                        lambda: False)
+    try:
+        with pytest.raises(LightGBMError,
+                           match=r"coordinator at 10\.99\.0\.1:45999"):
+            distributed.init(machines="10.99.0.1:45999", num_machines=1,
+                             process_id=0, connect_retries=3,
+                             connect_backoff=0.01)
+    finally:
+        distributed._initialized = False
+
+
+# ==================================================== real kill/respawn
+_CHILD_SCRIPT = r"""
+import numpy as np
+import lightgbm_tpu as lgb
+rng = np.random.RandomState(0)
+X = rng.randn(400, 10)
+y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.randn(400)
+params = {{"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbosity": -1, "bagging_fraction": 0.6, "bagging_freq": 2,
+          "feature_fraction": 0.8}}
+ds = lgb.Dataset(X, label=y, params=params, free_raw_data=False)
+lgb.train(dict(params), ds, num_boost_round=10,
+          callbacks=[lgb.checkpoint_callback({ckdir!r}, period=1)],
+          resume_from={ckdir!r})
+print("TRAINING_COMPLETE")
+"""
+
+
+@pytest.mark.slow
+def test_subprocess_kill_and_respawn_bit_identical(tmp_path):
+    """The full preemption shape: a child process is hard-killed
+    (os._exit(137), no cleanup) mid-training by the fault harness, a fresh
+    process auto-resumes from the checkpoint directory, and the final
+    model text equals an uninterrupted run's byte for byte."""
+    ckdir = str(tmp_path / "ck")
+    script = _CHILD_SCRIPT.format(ckdir=ckdir)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LGBM_TPU_FAULT_KILL_AT_ITER="6")
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 137, proc.stderr[-2000:]
+    assert "TRAINING_COMPLETE" not in proc.stdout
+    # respawn without the fault armed: auto-resume finishes the run
+    env.pop("LGBM_TPU_FAULT_KILL_AT_ITER")
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "TRAINING_COMPLETE" in proc.stdout
+    # the surviving checkpoint holds the full 10-iteration model,
+    # bit-identical to an uninterrupted in-process run
+    X, y = _data()
+    params = {**BASE, **MODE_PARAMS["gbdt"]}
+    full = _train(params, X, y, 10).model_to_string()
+    ck = CheckpointManager(ckdir).load_latest_valid()
+    assert ck.iteration == 10
+    assert ck.model_text == full
+
+
+# ============================================================= misc bits
+def test_params_hash_ignores_io_knobs():
+    a = lgb.Config.from_params({"num_leaves": 7, "verbosity": -1})
+    b = lgb.Config.from_params({"num_leaves": 7, "verbosity": 2,
+                                "output_model": "elsewhere.txt"})
+    c = lgb.Config.from_params({"num_leaves": 9})
+    assert params_hash(a) == params_hash(b)
+    assert params_hash(a) != params_hash(c)
+    # list-typed params participate too (to_params() omits them; the hash
+    # must not): constraints change => different model => different hash
+    d = lgb.Config.from_params({"num_leaves": 7,
+                                "monotone_constraints": [1, -1, 0]})
+    e = lgb.Config.from_params({"num_leaves": 7,
+                                "max_bin_by_feature": [16, 32]})
+    assert params_hash(d) != params_hash(a)
+    assert params_hash(e) != params_hash(a)
+
+
+def test_checkpoint_submodule_importable():
+    # lgb.checkpoint must be the submodule on a fresh import (not only
+    # after a Booster construction lazily pulls it in)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import lightgbm_tpu as lgb; "
+         "assert lgb.checkpoint.CheckpointManager; "
+         "assert lgb.checkpoint_callback"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+
+
+def test_fault_env_overrides_in_both_directions(monkeypatch):
+    cfg = lgb.Config.from_params({"fault_corrupt_checkpoint": True,
+                                  "fault_kill_at_iter": 3})
+    # env set to "off" values DISARMS config-armed faults (env wins)
+    monkeypatch.setenv("LGBM_TPU_FAULT_CORRUPT_CHECKPOINT", "0")
+    monkeypatch.setenv("LGBM_TPU_FAULT_KILL_AT_ITER", "-1")
+    assert faults.plan_from(cfg) is None
+
+
+def test_dataset_fingerprint_tracks_labels():
+    X, y = _data()
+    ds1 = lgb.Dataset(X, label=y).construct()
+    ds2 = lgb.Dataset(X, label=y + 1.0).construct()
+    ds3 = lgb.Dataset(X, label=y).construct()
+    assert dataset_fingerprint(ds1) == dataset_fingerprint(ds3)
+    assert dataset_fingerprint(ds1) != dataset_fingerprint(ds2)
