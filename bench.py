@@ -54,6 +54,11 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True):
     # loop (and everything after) sync-free.
     profiling.reset()
     profiling.enable(True)
+    # dispatch/host-sync telemetry for the timed loop (dispatches_per_iter
+    # / host_bytes_per_iter JSON fields): counts compiled-program launches
+    # and explicit host<->device transfer bytes — the non-histogram
+    # overhead the fused iteration exists to kill
+    telemetry = profiling.install_dispatch_hook()
 
     def mark(name):
         # stream phase completions so a wedged tunnel RPC is attributable
@@ -110,15 +115,26 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True):
     profiling.enable(False)
 
     # drain outstanding async work so warmup doesn't leak into the timing
-    _ = float(booster._boosting.train_score[0])
+    _ = float(booster._boosting.train_score[0].ravel()[0])
+    disp0 = profiling.dispatch_stats()
     t0 = time.time()
     for _ in range(args.iters):
         booster.update()
+    # snapshot the counters BEFORE the completion fetch: the fetch is
+    # measurement infrastructure, not part of an iteration
+    disp1 = profiling.dispatch_stats()
     # force completion: fetch a scalar that depends on the training state
     # (block_until_ready does not reliably block through the axon tunnel)
-    _ = float(booster._boosting.train_score[0])
+    _ = float(booster._boosting.train_score[0].ravel()[0])
     sec_per_iter = (time.time() - t0) / args.iters
     phases["sec_per_iter"] = sec_per_iter
+    disp_per_iter = host_bytes_per_iter = None
+    if telemetry:
+        d = profiling.dispatch_delta(disp0, disp1)
+        disp_per_iter = d["dispatches"] / args.iters
+        host_bytes_per_iter = (d["d2h_bytes"] + d["h2d_bytes"]) / args.iters
+        mark(f"dispatch telemetry: {disp_per_iter:.1f} dispatches/iter, "
+             f"{host_bytes_per_iter:.0f} host bytes/iter")
     mark(f"timed_iters ({sec_per_iter:.3f} s/iter)")
 
     # quality anchor: continue to --rounds total iterations, then held-out
@@ -151,7 +167,8 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True):
     rows_per_tree = booster._boosting.rows_streamed_per_tree
     mark(f"rows_streamed_per_tree={rows_per_tree:.0f} "
          f"(compaction={'on' if hist_compaction else 'off'})")
-    return sec_per_iter, phases, auc, max(args.rounds, done), rows_per_tree
+    return (sec_per_iter, phases, auc, max(args.rounds, done), rows_per_tree,
+            disp_per_iter, host_bytes_per_iter)
 
 
 def main():
@@ -221,7 +238,8 @@ def main():
         for hm in ("auto", "onehot"):
             try:
                 print(f"# trying rows={rows} hist={hm}", file=sys.stderr)
-                sec_per_iter, phases, auc, rounds_run, rows_per_tree = \
+                (sec_per_iter, phases, auc, rounds_run, rows_per_tree,
+                 disp_per_iter, host_bytes_per_iter) = \
                     run_at_scale(rows, args, hist_method=hm)
                 used_rows = rows
                 used_method = hm
@@ -263,6 +281,16 @@ def main():
         "auc": round(auc, 6) if auc is not None else None,
         "auc_rounds": rounds_run,
         "hist_method": used_method,
+        # dispatch/host-sync telemetry over the timed loop (see
+        # utils/profiling.py install_dispatch_hook): compiled-program
+        # launches and explicit host<->device transfer bytes per
+        # iteration — the fused one-dispatch iteration holds the former
+        # at 2 (grow step + donated score add); null when the jax
+        # internals hook is unavailable
+        "dispatches_per_iter": round(disp_per_iter, 2)
+        if disp_per_iter is not None else None,
+        "host_bytes_per_iter": round(host_bytes_per_iter, 1)
+        if host_bytes_per_iter is not None else None,
         # the main run has compaction ON (the default): these two fields
         # are the compacted numbers; the nocompact_* probe below supplies
         # the uncompacted side of the headroom comparison
@@ -292,7 +320,7 @@ def main():
     nc_sec = nc_rows = None
     if probe_headroom("nocompact"):
         try:
-            nc_sec, _, _, _, nc_rows = run_at_scale(
+            nc_sec, _, _, _, nc_rows, _, _ = run_at_scale(
                 used_rows, args, hist_method=used_method,
                 hist_compaction=False)
             print(f"# nocompact probe: {nc_sec:.3f} s/iter, "
@@ -319,7 +347,7 @@ def main():
     if (used_method == "auto" and jax.default_backend() == "tpu"
             and probe_headroom("q8")):
         try:
-            q8_sec, q8_ph, q8_auc, _, _ = run_at_scale(
+            q8_sec, q8_ph, q8_auc, _, _, _, _ = run_at_scale(
                 used_rows, args, hist_method="pallas_q8")
             print(f"# q8 probe: {q8_sec:.3f} s/iter, auc={q8_auc}",
                   file=sys.stderr)
@@ -339,7 +367,7 @@ def main():
             and args.max_bin != 63 and probe_headroom("bin63")):
         try:
             b63_args = argparse.Namespace(**{**vars(args), "max_bin": 63})
-            b63_sec, b63_ph, b63_auc, _, _ = run_at_scale(
+            b63_sec, b63_ph, b63_auc, _, _, _, _ = run_at_scale(
                 used_rows, b63_args, hist_method="auto")
             print(f"# max_bin=63: {b63_sec:.3f} s/iter, "
                   f"auc={b63_auc}", file=sys.stderr)
@@ -352,7 +380,7 @@ def main():
         # the projected fastest configuration, with its own AUC readout
         if probe_headroom("bin63+q8"):
             try:
-                b63q8_sec, _, b63q8_auc, _, _ = run_at_scale(
+                b63q8_sec, _, b63q8_auc, _, _, _, _ = run_at_scale(
                     used_rows, b63_args, hist_method="pallas_q8")
                 print(f"# max_bin=63 + q8: {b63q8_sec:.3f} s/iter, "
                       f"auc={b63q8_auc}", file=sys.stderr)

@@ -16,8 +16,14 @@ src/io/dataset_loader.cpp DatasetLoader):
 
 The binned matrix lives device-resident as ``[N, F_used]`` uint8/int32 — the
 TPU analog of the reference's FeatureGroup bin storage (dense_bin.hpp), laid
-out row-major for row-blocked histogram kernels. EFB bundling
-(feature_group.h) is unnecessary for dense device storage and is not applied.
+out row-major for row-blocked histogram kernels. EFB bundling IS applied on
+the sparse construction path (``_construct_sparse`` -> bundling.py, the
+analog of dataset.cpp:239 FastFeatureBundling): mutually-exclusive sparse
+features share one dense device column each, so the matrix is ``[N, G]``
+with G ~ bundles rather than features; dense float input skips bundling
+(every column already owns its device column). High-sparsity columns can
+further drop out of the dense matrix entirely into (row, bin) streams
+(``_maybe_extract_sparse``, the SparseBin analog).
 """
 
 from __future__ import annotations

@@ -248,7 +248,13 @@ class CheckpointManager:
                     model_text = fh.read()
                 with open(os.path.join(path, STATE_NAME), "rb") as fh:
                     state = pickle.load(fh)
-            except (ValueError, OSError, pickle.UnpicklingError, EOFError) as e:
+            except (ValueError, OSError, pickle.UnpicklingError, EOFError,
+                    TypeError) as e:
+                # TypeError covers structurally-incompatible pickles: a
+                # namedtuple in the state (e.g. GrowAux) that gained a
+                # field since the checkpoint was written unpickles via
+                # cls(*old_fields) and raises TypeError — treat it like
+                # corruption and fall back rather than crash the resume
                 log.warning(f"checkpoint {os.path.basename(path)} is corrupt "
                             f"or truncated ({e}); falling back to the "
                             f"previous checkpoint")

@@ -337,6 +337,12 @@ class Config:
     hist_compaction: bool = True
     hist_compaction_ladder: List[float] = field(
         default_factory=lambda: [0.5, 0.125])
+    # run gradients -> tree growth -> score update as ONE jitted program
+    # per boosting iteration whenever the configuration allows it (see
+    # models/gbdt.py _fused_ok for the gate and its remaining exclusions).
+    # false forces the phase-by-phase path — a debugging escape hatch and
+    # the reference side of the fused-vs-unfused bit-parity test suite.
+    fused_iteration: bool = True
 
     def __post_init__(self):
         if self.seed is not None:

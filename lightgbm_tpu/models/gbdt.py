@@ -31,7 +31,7 @@ from ..metrics import Metric, create_metric, default_metric_for_objective
 from ..objectives import ObjectiveFunction, create_objective
 from ..ops.split import SplitParams
 from ..utils import log
-from .grower import grow_tree
+from .grower import GrowAux, grow_tree
 from .tree import (HostTree, TreeArrays, predict_leaf_bins,
                    predict_leaves_stacked, predict_value_bins,
                    predict_values_stacked, stack_trees)
@@ -99,6 +99,22 @@ def _bagging_subset(key: jax.Array, bins: jax.Array, k: int):
     return mask, sub_idx, sub_bins, sub_bins.T
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _apply_score_delta(score: jax.Array, delta: jax.Array) -> jax.Array:
+    """Score-cache update for the fused iteration, as its OWN tiny program
+    with the score buffer DONATED: the add writes in place instead of
+    allocating a fresh [N, K] cache every iteration. Kept separate from
+    the fused grow program on purpose — inside one XLA loop fusion the
+    backend contracts the leaf-value*lr multiply and this add into an FMA
+    whose single rounding drifts 1 ulp from the unfused path (observed on
+    CPU even across an optimization_barrier), breaking the fused-vs-
+    unfused bit-parity the suite asserts. ``delta`` arrives [N] (one
+    class) or [K, N] (the fused multiclass scan's stacked layout); the
+    column-disjoint adds are bit-identical to the unfused per-class
+    ``at[:, c].add`` sequence."""
+    return score + (delta.T if delta.ndim == 2 else delta)
+
+
 def _shrink_tree(tree: TreeArrays, lr: float) -> TreeArrays:
     """Apply the learning rate to a tree's value-bearing fields
     (Tree::Shrinkage, tree.h:187). Works on device or host-mirrored
@@ -140,7 +156,14 @@ class GBDT:
         # (reference: gbdt.h num_init_iteration_, engine.py:163-169)
         self.loaded = None
         self.loaded_iters = 0
-        self._fused_cache: Dict[str, object] = {}  # hist method -> jitted step
+        # fused-iteration compile cache: static-options tuple (see
+        # _fused_step_fn's key) -> (jitted step, dataset-constant bind).
+        # Bounded: parallel-learner binds pin padded full-dataset copies,
+        # so stale entries from reset_parameter sweeps must be evicted
+        self._fused_cache: Dict[tuple, tuple] = {}
+        # (learner, forced-splits, padded dataset bind) per binsT flavor —
+        # see _fused_parallel_bindings
+        self._fused_bind_cache: Dict[bool, tuple] = {}
         self._mt_cache: Dict[int, object] = {}   # host-tree idx -> ModelTree
         self._valid_raw_cache: Dict[int, jax.Array] = {}
         self._stacked_cache: Optional[Tuple[int, TreeArrays]] = None
@@ -215,7 +238,10 @@ class GBDT:
     _supports_lazy_host = True   # DART/RF override: they touch host trees
     _rows_streamed_dev = 0.0     # overwritten per-train; float for loaded
                                  # boosters that never trained here
+    _coll_bytes_dev = 0.0        # ditto (collective-volume telemetry)
     _fault_plan = None           # set per-train (utils/faults injection)
+    _bag_stale = False           # fused iterations draw bagging in-program;
+                                 # the host mask re-derives on next use
 
     # ------------------------------------------------------------ setup
     def _init_train(self, train_set: Dataset) -> None:
@@ -305,10 +331,12 @@ class GBDT:
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed)
         self._bag_mask = jnp.ones((n,), dtype=jnp.float32)
         self._bag_sub = None
-        # compaction telemetry: rows read by histogram passes, accumulated
-        # ON DEVICE so the lazy dispatch pipeline never syncs for it
-        # (reading the properties below does)
+        # compaction / collective telemetry: rows read by histogram passes
+        # and histogram-plane collective bytes, accumulated ON DEVICE so
+        # the lazy dispatch pipeline never syncs for them (reading the
+        # properties below does)
         self._rows_streamed_dev = jnp.float32(0.0)
+        self._coll_bytes_dev = jnp.float32(0.0)
         self._need_bagging = (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0) or \
             (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
 
@@ -537,19 +565,16 @@ class GBDT:
         self._valid_scores.append(jnp.asarray(np.ascontiguousarray(base)))
 
     # ---------------------------------------------------------- sampling
-    def _update_bagging(self) -> None:
-        """Bagging mask refresh (reference: gbdt.cpp:228-262 Bagging;
-        pos/neg bagging per config.h:268-280). The mask comes from the
-        device PRNG — no per-period host uniform draw + upload."""
+    def _bagging_mode(self) -> str:
+        """STATIC bagging flavor for the current config: "off" | "mask" |
+        "subset". The subset rule mirrors the reference's compact-copy
+        heuristic (gbdt.cpp:810-818): small enough fraction that a compact
+        row copy beats masked full-N histogram passes; serial learner and
+        plain fraction only. The single definition the host refresh below
+        and the fused in-program draw share."""
         cfg = self.config
-        if not self._need_bagging:
-            return
-        if cfg.bagging_freq <= 0 or self.iter % cfg.bagging_freq != 0:
-            return
-        n = self._n_score_rows
-        # subset copy when the fraction is small enough that compacting
-        # beats masked full-N histogram passes (the reference's rule,
-        # gbdt.cpp:810-818); serial learner, plain fraction only
+        if not self._need_bagging or cfg.bagging_freq <= 0:
+            return "off"
         use_subset = (cfg.bagging_fraction <= 0.5
                       and cfg.pos_bagging_fraction >= 1.0
                       and cfg.neg_bagging_fraction >= 1.0
@@ -560,15 +585,17 @@ class GBDT:
                       # copy compacts rows, so it takes the mask path
                       and not getattr(self.train_set, "has_sparse_cols",
                                       False))
-        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed),
-                                 self.iter)
-        if use_subset:
-            k = max(1, int(round(n * cfg.bagging_fraction)))
-            self._bag_mask, sub_idx, sub_bins, sub_binsT = _bagging_subset(
-                key, self.train_set.bins, k)
-            self._bag_sub = (sub_idx, sub_bins, sub_binsT)
-            return
-        self._bag_sub = None
+        return "subset" if use_subset else "mask"
+
+    def _subset_rows(self) -> int:
+        """Static row count of the bagging subset copy."""
+        return max(1, int(round(self._n_score_rows
+                                * self.config.bagging_fraction)))
+
+    def _bagging_frac(self):
+        """Per-row (pos/neg) or scalar keep-probability for the mask mode
+        (config.h:268-280), built lazily and cached until reset_config."""
+        cfg = self.config
         if getattr(self, "_bag_frac", None) is None:
             if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
                 pos = self.objective.label_np > 0 \
@@ -579,7 +606,34 @@ class GBDT:
                     cfg.neg_bagging_fraction).astype(np.float32))
             else:
                 self._bag_frac = jnp.float32(cfg.bagging_fraction)
-        self._bag_mask = _bagging_mask(key, self._bag_frac, n)
+        return self._bag_frac
+
+    def _update_bagging(self) -> None:
+        """Bagging mask refresh (reference: gbdt.cpp:228-262 Bagging;
+        pos/neg bagging per config.h:268-280). The mask comes from the
+        device PRNG — no per-period host uniform draw + upload. The draw
+        is keyed on the PERIOD-START iteration, so it is deterministic in
+        the iteration alone: a mid-period resume, or an unfused iteration
+        following fused ones (which draw the same key in-program and leave
+        the host mask stale), re-derives the exact same mask."""
+        cfg = self.config
+        mode = self._bagging_mode()
+        if mode == "off":
+            return
+        if self.iter % cfg.bagging_freq != 0 and not self._bag_stale:
+            return
+        period_start = (self.iter // cfg.bagging_freq) * cfg.bagging_freq
+        key = jax.random.fold_in(jax.random.PRNGKey(cfg.bagging_seed),
+                                 period_start)
+        self._bag_stale = False
+        if mode == "subset":
+            self._bag_mask, sub_idx, sub_bins, sub_binsT = _bagging_subset(
+                key, self.train_set.bins, self._subset_rows())
+            self._bag_sub = (sub_idx, sub_bins, sub_binsT)
+            return
+        self._bag_sub = None
+        self._bag_mask = _bagging_mask(key, self._bagging_frac(),
+                                       self._n_score_rows)
 
     def _feature_mask(self) -> jax.Array:
         """Per-tree column sampling (reference: col_sampler.hpp:20-50
@@ -594,35 +648,61 @@ class GBDT:
         mask[chosen] = 1.0
         return jnp.asarray(mask)
 
+    def _feature_mask_np(self) -> Optional[np.ndarray]:
+        """Host-side per-class feature-fraction masks for the fused step
+        ([K, F] float32), drawn from the SAME stateful rng in the same
+        per-tree order as the unfused path's _feature_mask calls (bit-
+        parity). None when column sampling is off — the fused step then
+        builds a constant all-ones mask in-program, so a steady-state
+        iteration uploads nothing."""
+        f = self.train_set.num_used_features()
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return None
+        k = self.num_tree_per_iteration
+        kk = max(1, int(round(f * frac)))
+        masks = np.zeros((k, f), dtype=np.float32)
+        for c in range(k):
+            masks[c, self._feat_rng.choice(f, size=kk, replace=False)] = 1.0
+        return masks
+
     # ------------------------------------------------------------ train
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
         return self.objective.get_grad_hess(self.train_score)
 
     def _fused_ok(self, grad_external) -> bool:
         """Whether this iteration can run gradients -> growth -> score
-        update as ONE jitted program (see _fused_step_fn). The gate mirrors
-        the serial fast path: per-class loops, host-side leaf renewal,
-        linear fitting, CEGB state, forced splits and the bagging subset
-        copy all interleave host work between the phases."""
+        update as ONE jitted program (see _fused_step_fn).
+
+        The gate is wide: multiclass (all class trees grow inside the one
+        program via a lax.scan over the class axis), the data/feature/
+        voting parallel learners (the same shard_map'd grower the unfused
+        path uses, embedded in the fused program), the bagging mask AND
+        subset copy (drawn in-program from the period-start key), CEGB
+        (its cross-iteration aux rides through as device-resident loop
+        state), interaction constraints, per-node feature sampling and
+        forced splits (constant device tables closed over).
+
+        What remains excluded genuinely interleaves HOST work between the
+        phases: externally supplied gradients (fobj), objectives with
+        host-side leaf renewal, linear-leaf fitting (host lstsq per leaf),
+        the check_numerics / NaN-injection guards (they inspect gradients
+        on host by design), and multi-controller / pre-partitioned runs
+        (per-process array globalization between phases)."""
         cfg = self.config
         return (type(self) is GBDT
+                and cfg.fused_iteration
                 and grad_external is None
                 # numerics checks and NaN-gradient injection both need the
                 # gradients materialized outside the fused program
                 and not cfg.check_numerics
                 and (self._fault_plan is None
                      or not self._fault_plan.wants_nan_grad)
-                and self.num_tree_per_iteration == 1
-                and self._parallel_grower is None
                 and self.objective is not None
                 and not self.objective.need_renew_tree_output
                 and getattr(self.objective, "jit_safe_gradients", True)
                 and not cfg.linear_tree
-                and self._cegb_mode == "off"
-                and not self._with_interactions
-                and not self._use_bynode
-                and self._forced_splits is None
-                and self._bag_sub is None
+                and jax.process_count() == 1
                 and not getattr(self, "_pre_part", False)
                 # 0-feature datasets take _grow_one's constant-tree path
                 and (self.train_set.bins.shape[1] > 0
@@ -647,11 +727,35 @@ class GBDT:
             with_monotone=self._with_monotone,
             mono_mode=self._mono_mode,
             mono_features=self._mono_features,
+            with_interactions=self._with_interactions,
+            cegb_mode=self._cegb_mode,
+            use_bynode=self._use_bynode,
             extra_trees=cfg.extra_trees,
             hist_dp=self._hist_dp,
             hist_subtraction=cfg.hist_subtraction and fb == 0,
             sp_cols=tuple(int(c) for c in ts.sp_cols) if has_sp else (),
             compaction_ladder=() if fb else self._compaction_ladder())
+
+    def _parallel_grow_statics(self, hm: str) -> dict:
+        """STATIC grow options for the configured parallel learner — like
+        _serial_grow_statics, the single definition the unfused _grow_one
+        call site and the fused step share (the two also share the
+        compiled shard_map program through ParallelGrower.get_shard_fn)."""
+        cfg = self.config
+        ts = self.train_set
+        return dict(
+            max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
+            max_depth=cfg.max_depth, hist_method=hm,
+            tile_leaves=cfg.tile_leaves,
+            hist_block=cfg.hist_block,
+            exact=cfg.tree_growth_mode == "exact",
+            with_categorical=ts.has_categorical,
+            with_monotone=self._with_monotone,
+            mono_mode=self._mono_mode,
+            mono_features=self._mono_features,
+            extra_trees=cfg.extra_trees,
+            hist_subtraction=cfg.hist_subtraction,
+            vote_top_k=cfg.top_k, hist_dp=self._hist_dp)
 
     def _compaction_ladder(self) -> tuple:
         """Static row-buffer sizes for the grower's leaf-partitioned row
@@ -665,7 +769,7 @@ class GBDT:
         ts = self.train_set
         if not cfg.hist_compaction or ts is None:
             return ()
-        base = (self._bag_sub[0].shape[0] if self._bag_sub is not None
+        base = (self._subset_rows() if self._bagging_mode() == "subset"
                 else (ts.num_local_data if getattr(self, "_pre_part", False)
                       else ts.num_data))
         rungs = set()
@@ -675,46 +779,233 @@ class GBDT:
                 rungs.add(m)
         return tuple(sorted(rungs))
 
-    def _fused_step_fn(self, hm: str):
-        """One jitted program per boosting iteration for the serial fast
-        path: objective gradients -> tree growth -> shrunk score delta,
-        fused so the host dispatches ONCE per iteration (three dispatches
-        otherwise — each a transport round trip through a TPU tunnel) and
-        XLA fuses the elementwise gradient math into the grower's first
-        histogram pass instead of materializing grad/hess through HBM.
-        The reference's TrainOneIter phases (gbdt.cpp:369-452) collapse
-        into one program; the TREE is returned unshrunk and finalize
-        applies the learning rate exactly as in the unfused path.
+    def _fused_cegb_state(self) -> Optional[GrowAux]:
+        """CEGB's cross-iteration feature-used tracking as an explicit
+        fused-step operand (cost_effective_gradient_boosting.hpp Init:
+        !init_ reuse). A zero aux is materialized once at the first
+        iteration so the step's operand structure stays trace-stable."""
+        if self._cegb_mode == "off":
+            return None
+        if self._cegb_aux is None:
+            ts = self.train_set
+            f = ts.num_used_features()
+            n = self._n_score_rows
+            lazy = self._cegb_mode == "lazy"
+            self._cegb_aux = GrowAux(
+                used_split=jnp.zeros((f,), bool),
+                row_used=jnp.zeros((n, f) if lazy else (1, 1), bool),
+                rows_streamed=jnp.float32(0.0),
+                coll_bytes=jnp.float32(0.0))
+        return self._cegb_aux
 
-        Cached by the STATIC grow options (+ objective identity), so
-        dynamic-parameter resets (learning_rates schedules) never retrace."""
+    def _fused_parallel_bindings(self, hm: str):
+        """Padded dataset-constant arrays for the fused parallel step,
+        through the SAME ParallelGrower padding/extras helpers the
+        unfused ``__call__`` uses (single source of truth) — but built
+        ONCE and cached per (learner, binsT-needed) instead of per call;
+        the per-iteration grad/hess/mask pads move inside the jitted
+        program. The sub-cache is keyed separately from the fused step
+        cache so a reset_parameter sweep over step statics never
+        duplicates the padded O(N*F) dataset copies."""
+        pg = self._parallel_grower
+        ts = self.train_set
+        use_binsT = hm.startswith(("onehot", "pallas"))
+        hit = self._fused_bind_cache.get(use_binsT)
+        # identity-checked (not id-keyed): a reset_config can replace the
+        # learner or the forced-split tables; the old objects stay alive
+        # inside the stale entry, so an `is` match is exact
+        if (hit is not None and hit[0] is pg
+                and hit[1] is self._forced_splits):
+            return hit[2]
+        (bins, binsT, meta, missing_bin, bundle_meta,
+         n_pad, f_pad) = pg.pad_replicated_inputs(
+            ts.bins, ts.bins_T if use_binsT else None, ts.feature_meta,
+            ts.missing_bin, ts.bundle_meta)
+        extras, extras_spec = pg.build_extras(binsT, bundle_meta,
+                                              self._forced_splits)
+        pb = dict(bins=bins, extras=extras, extras_spec=extras_spec,
+                  meta=meta, missing_bin=missing_bin, n=ts.bins.shape[0],
+                  n_pad=n_pad, f_pad=f_pad)
+        self._fused_bind_cache[use_binsT] = (pg, self._forced_splits, pb)
+        return pb
+
+    def _fused_step_fn(self, hm: str, fmask_on: bool):
+        """One jitted program per boosting iteration: objective gradients
+        -> bagging draw -> per-class tree growth -> shrinkage -> score
+        deltas, fused so the host dispatches the whole grow phase ONCE
+        (three-plus dispatches otherwise, and per-class multiples for
+        multiclass — each a transport round trip through a TPU tunnel)
+        and XLA fuses the elementwise gradient math into the grower's
+        first histogram pass instead of materializing grad/hess through
+        HBM. The reference's TrainOneIter phases (gbdt.cpp:369-452)
+        collapse into one program:
+
+        - multiclass grows all ``num_tree_per_iteration`` class trees via
+          a ``lax.scan`` over the class axis — the grower (and its
+          histogram workspace) is compiled ONCE and reused per class,
+          mirroring the reference's single logical TrainOneIter;
+        - the parallel learners run the SAME shard_map'd grower the
+          unfused path uses (ParallelGrower.get_shard_fn), embedded in
+          the fused program, so distributed iterations also collapse to
+          one dispatch;
+        - bagging (mask or subset copy) is drawn in-program from the
+          period-start key — bit-identical to the host refresh draw and
+          never interleaved as a separate dispatch;
+        - CEGB's cross-iteration aux rides through as device-resident
+          loop state (operand in, operand out).
+
+        The score update itself is the SECOND (and last) dispatch of the
+        iteration — ``_apply_score_delta``, a donated in-place add kept
+        out of this program so the backend cannot FMA-contract it against
+        the leaf-value shrinkage (see its docstring; bit-parity). Trees
+        are returned SHRUNK (Tree::Shrinkage applied in-program — the
+        same elementwise multiply finalize would apply). Cached by the
+        STATIC grow options (+ objective/constant identities), so
+        dynamic-parameter resets (learning_rates schedules) never
+        retrace. Returns ``(step, bind)`` where ``bind`` holds the
+        dataset-constant operands the caller passes each iteration."""
         ts = self.train_set
         obj = self.objective
-        grow_kw = self._serial_grow_statics(hm)
-        key = (id(obj),) + tuple(grow_kw[k] for k in sorted(grow_kw))
-        step = self._fused_cache.get(key)
-        if step is not None:
-            return step
+        cfg = self.config
+        k = self.num_tree_per_iteration
+        pg = self._parallel_grower
+        bag_mode = self._bagging_mode()
+        sub_k = self._subset_rows() if bag_mode == "subset" else 0
+        frac_kind = "arr" if (bag_mode == "mask"
+                              and (cfg.pos_bagging_fraction < 1.0
+                                   or cfg.neg_bagging_fraction < 1.0)) \
+            else bag_mode
+        grow_kw = self._parallel_grow_statics(hm) if pg is not None \
+            else self._serial_grow_statics(hm)
+        key = (id(obj), k, bag_mode, sub_k, frac_kind, fmask_on,
+               pg.mode if pg is not None else "serial",
+               cfg.bagging_freq, cfg.bagging_seed, cfg.extra_seed,
+               # the by-node fraction is closed over below (a constant of
+               # the program): key it so a reset_parameter change
+               # retraces instead of silently keeping the old fraction
+               cfg.feature_fraction_bynode if self._use_bynode else None,
+               id(self._interaction_groups), id(self._cegb_coupled),
+               id(self._cegb_lazy), id(self._forced_splits),
+               ) + tuple(grow_kw[k2] for k2 in sorted(grow_kw))
+        hit = self._fused_cache.get(key)
+        if hit is not None:
+            return hit
         from .tree import leaf_values_of_rows
+        n = self._n_score_rows
+        f_used = ts.num_used_features()
+        freq = cfg.bagging_freq
+        extra_key = self._extra_rng_key
+        bag_key0 = jax.random.PRNGKey(cfg.bagging_seed)
+        has_sp = getattr(ts, "has_sparse_cols", False)
+        cegb_on = self._cegb_mode != "off"
+        ig = self._interaction_groups
+        cegb_coupled = self._cegb_coupled
+        cegb_lazy = self._cegb_lazy
+        forced = self._forced_splits
+        bynode_frac = (jnp.float32(cfg.feature_fraction_bynode)
+                       if self._use_bynode else None)
+        if pg is not None:
+            pb = self._fused_parallel_bindings(hm)
+            shard = pg.get_shard_fn(pb["extras_spec"],
+                                    tuple(sorted(grow_kw.items())))
+            bind = dict(bins=pb["bins"], binsT=None, sp_rows=None,
+                        sp_bins=None, sp_default=None, extras=pb["extras"])
+        else:
+            pb = shard = None
+            bind = dict(bins=ts.bins,
+                        binsT=ts.bins_T if self._use_binsT(hm) else None,
+                        sp_rows=ts.sp_rows if has_sp else None,
+                        sp_bins=ts.sp_bins if has_sp else None,
+                        sp_default=ts.sp_default if has_sp else None,
+                        extras=None)
 
-        def step(score, bins, binsT, mask, fmask, sparams, iter_key, lr,
-                 sp_rows, sp_bins, sp_default):
+        def step(score, bins, binsT, fmask, sparams, it, lr, bag_frac,
+                 cegb_state, sp_rows, sp_bins, sp_default, extras,
+                 rows_acc, coll_acc):
             g, h = obj.get_grad_hess(score)
-            tree, leaf_id, aux = grow_tree(
-                bins, g, h, mask, ts.feature_meta, sparams, fmask,
-                ts.missing_bin, binsT=binsT, rng_key=iter_key,
-                bundle_meta=ts.bundle_meta, sp_rows=sp_rows,
-                sp_bins=sp_bins, sp_default=sp_default, **grow_kw)
-            # the score ADD happens eagerly in the caller: fused into this
-            # program XLA emits score + delta as an FMA, whose single
-            # rounding drifts 1 ulp from the unfused path and breaks the
-            # bit-parity the serial-vs-parallel tests assert
-            delta = leaf_values_of_rows(tree.leaf_value, leaf_id) * lr
-            return tree, leaf_id, delta, aux.rows_streamed
+            # ---- bagging, derived from the period-start key: the exact
+            # draw _update_bagging performs on the host path
+            mask = jnp.ones((n,), jnp.float32)
+            sub = None
+            if bag_mode != "off":
+                bkey = jax.random.fold_in(bag_key0, (it // freq) * freq)
+                if bag_mode == "mask":
+                    u = jax.random.uniform(bkey, (n,))
+                    mask = (u < bag_frac).astype(jnp.float32)
+                else:
+                    r = jax.random.bits(bkey, (n,), jnp.uint32)
+                    sub_idx = jnp.argsort(r)[:sub_k].astype(jnp.int32)
+                    sub_bins = jnp.take(bins, sub_idx, axis=0)
+                    sub = (sub_idx, sub_bins, sub_bins.T)
+
+            def grow_c(gc, hc, fmask_c, key_c, cegb_aux):
+                if pg is None:
+                    tree, leaf_id, aux = grow_tree(
+                        bins, gc, hc, mask, ts.feature_meta, sparams,
+                        fmask_c, ts.missing_bin, binsT=binsT,
+                        rng_key=key_c, bundle_meta=ts.bundle_meta,
+                        forced_splits=forced,
+                        sub_idx=sub[0] if sub else None,
+                        sub_bins=sub[1] if sub else None,
+                        sub_binsT=sub[2] if sub else None,
+                        interaction_groups=ig,
+                        cegb_coupled=cegb_coupled,
+                        cegb_lazy_penalty=cegb_lazy,
+                        cegb_state=cegb_aux,
+                        bynode_fraction=bynode_frac,
+                        sp_rows=sp_rows, sp_bins=sp_bins,
+                        sp_default=sp_default, **grow_kw)
+                else:
+                    gp = jnp.pad(gc, (0, pb["n_pad"]))
+                    hp = jnp.pad(hc, (0, pb["n_pad"]))
+                    mp = jnp.pad(mask, (0, pb["n_pad"]))
+                    fp = jnp.pad(fmask_c, (0, pb["f_pad"]))
+                    tree, leaf_id, aux = shard(
+                        bins, gp, hp, mp, pb["meta"], sparams, fp,
+                        pb["missing_bin"], extras, key_c)
+                    leaf_id = leaf_id[:n]
+                delta = leaf_values_of_rows(tree.leaf_value, leaf_id) * lr
+                return _shrink_tree(tree, lr), delta, aux
+
+            fm = fmask if fmask_on else jnp.ones((k, f_used), jnp.float32)
+            if k == 1:
+                key0 = jax.random.fold_in(extra_key, it * k)
+                tree, delta, aux = grow_c(g, h, fm[0], key0, cegb_state)
+                trees = (tree,)
+                rows, coll = aux.rows_streamed, aux.coll_bytes
+                cegb_out = aux if cegb_on else None
+            else:
+                keys = jax.vmap(
+                    lambda c: jax.random.fold_in(extra_key, it * k + c))(
+                        jnp.arange(k, dtype=jnp.int32))
+
+                def body(carry, xs):
+                    gc, hc, fmask_c, key_c = xs
+                    tree, delta_c, aux = grow_c(gc, hc, fmask_c, key_c,
+                                                carry if cegb_on else
+                                                cegb_state)
+                    return (aux if cegb_on else carry,
+                            (tree, delta_c, aux.rows_streamed,
+                             aux.coll_bytes))
+
+                carry0 = cegb_state if cegb_on else jnp.int32(0)
+                carry, (trees_st, delta, rows_st, coll_st) = jax.lax.scan(
+                    body, carry0, (g.T, h.T, fm, keys))
+                trees = tuple(jax.tree.map(lambda x: x[c], trees_st)
+                              for c in range(k))
+                rows, coll = jnp.sum(rows_st), jnp.sum(coll_st)
+                cegb_out = carry if cegb_on else None
+            return (trees, delta, rows_acc + rows, coll_acc + coll,
+                    cegb_out)
 
         step = jax.jit(step)
-        self._fused_cache[key] = step
-        return step
+        if len(self._fused_cache) >= 8:
+            # oldest-entry eviction: each parallel bind can pin a padded
+            # O(N*F) dataset copy — a reset_parameter sweep over statics
+            # must not accumulate one per swept value
+            self._fused_cache.pop(next(iter(self._fused_cache)))
+        self._fused_cache[key] = (step, bind)
+        return step, bind
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
@@ -724,10 +1015,12 @@ class GBDT:
         cfg = self.config
         ts = self.train_set
         k = self.num_tree_per_iteration
+        if self._fused_ok(grad):
+            # the fused program draws its own bagging mask/subset from the
+            # period-start key — no host refresh dispatch
+            return self._train_one_iter_fused()
         self._update_bagging()
         mask = self._bag_mask
-        if self._fused_ok(grad):
-            return self._train_one_iter_fused(mask)
         with profiling.timer("gradients"):
             if grad is None:
                 g, h = self._gradients()
@@ -761,7 +1054,7 @@ class GBDT:
                                                     iter_key, hm)
                 grow_scope.sync(tree.num_leaves)
             if aux is not None:
-                self._record_rows_streamed(aux.rows_streamed)
+                self._record_aux_counters(aux)
             # pre-partitioned: leaf_id comes back row-sharded; keep only
             # this process's rows for the local score update (the
             # reference's per-machine score partition, score_updater.hpp —
@@ -803,43 +1096,65 @@ class GBDT:
         self._flush_pending(only_ready=True)
         return no_split or self._lagged_stop
 
-    def _train_one_iter_fused(self, mask: jax.Array) -> bool:
-        """Single-dispatch iteration (see _fused_step_fn); everything after
-        the step call mirrors the unfused path's finalize/add/bias flow."""
+    def _train_one_iter_fused(self) -> bool:
+        """Fused iteration for every admitted configuration (see
+        _fused_step_fn): TWO compiled-program dispatches — the fused grow
+        step and the donated in-place score add — versus three-plus (and
+        per-class multiples) on the unfused path; everything after
+        mirrors the unfused finalize/add/bias flow per class. The step
+        returns SHRUNK trees, so on the steady-state lazy path nothing
+        else dispatches — the telemetry tests assert it stays that way."""
         from ..utils import profiling
-        ts = self.train_set
         hm = self._hist_method()
-        has_sp = getattr(ts, "has_sparse_cols", False)
-        fmask = self._feature_mask()
-        iter_key = jax.random.fold_in(self._extra_rng_key, self.iter)
-        step = self._fused_step_fn(hm)
+        fmask = self._feature_mask_np()
+        step, bind = self._fused_step_fn(hm, fmask is not None)
+        bag_mode = self._bagging_mode()
+        bag_frac = self._bagging_frac() if bag_mode == "mask" else None
+        if bag_mode != "off":
+            self._bag_stale = True   # host mask not refreshed this iter
+        cegb_state = self._fused_cegb_state()
+        prev = None
+        if profiling.enabled():
+            prev = (float(self._rows_streamed_dev),
+                    float(self._coll_bytes_dev))
         with profiling.timer_sync("grow_tree") as grow_scope:
-            tree, leaf_id, delta, rows_streamed = step(
-                self.train_score, ts.bins,
-                ts.bins_T if self._use_binsT(hm) else None,
-                mask, fmask, self.split_params, iter_key,
-                jnp.float32(self.shrinkage_rate),
-                ts.sp_rows if has_sp else None,
-                ts.sp_bins if has_sp else None,
-                ts.sp_default if has_sp else None)
-            grow_scope.sync(tree.num_leaves)
-        self._record_rows_streamed(rows_streamed)
-        new_score = self.train_score + delta
+            (trees, delta, self._rows_streamed_dev,
+             self._coll_bytes_dev, cegb_aux) = step(
+                self.train_score, bind["bins"], bind["binsT"], fmask,
+                self.split_params, np.int32(self.iter),
+                np.float32(self.shrinkage_rate), bag_frac, cegb_state,
+                bind["sp_rows"], bind["sp_bins"], bind["sp_default"],
+                bind["extras"], self._rows_streamed_dev,
+                self._coll_bytes_dev)
+            grow_scope.sync(trees[0].num_leaves)
+        if cegb_aux is not None:
+            self._cegb_aux = cegb_aux
+        if prev is not None:
+            profiling.counter("hist_rows_streamed",
+                              float(self._rows_streamed_dev) - prev[0])
+            profiling.counter("hist_coll_bytes",
+                              float(self._coll_bytes_dev) - prev[1])
+        self.train_score = _apply_score_delta(self.train_score, delta)
         lazy = self._lazy_host_ok()
-        with profiling.timer("finalize_tree"):
-            if lazy:
-                tree = _shrink_tree(tree, self.shrinkage_rate)
-                t_host, had_split = None, True
-            else:
-                tree, t_host, had_split = self._finalize_tree(tree, leaf_id,
-                                                              0)
-        with profiling.timer("score_update", sync=None):
-            self._add_tree(tree, leaf_id, 0, t_host=t_host, lazy=lazy,
-                           new_score=new_score)
-            self._bias_after_score(0, had_split)
+        no_split = True
+        for c, tree in enumerate(trees):
+            with profiling.timer("finalize_tree"):
+                if lazy:
+                    t_host, had_split = None, True
+                else:
+                    # trees arrive pre-shrunk; renew/linear/check_numerics
+                    # are all excluded by _fused_ok, so finalize reduces
+                    # to the host-mirror fetch
+                    t_host = jax.device_get(tree)
+                    had_split = int(t_host.num_leaves) > 1
+            no_split = no_split and not had_split
+            with profiling.timer("score_update", sync=None):
+                self._add_tree(tree, None, c, t_host=t_host, lazy=lazy,
+                               score_updated=True)
+                self._bias_after_score(c, had_split)
         self.iter += 1
         self._flush_pending(only_ready=True)
-        return (not lazy and not had_split) or self._lagged_stop
+        return (not lazy and no_split) or self._lagged_stop
 
     def _grow_one(self, gc: jax.Array, hc: jax.Array, mask: jax.Array,
                   fmask: jax.Array, iter_key: jax.Array, hm: str):
@@ -868,18 +1183,7 @@ class GBDT:
                 rng_key=iter_key,
                 bundle_meta=ts.bundle_meta,
                 forced_splits=self._forced_splits,
-                max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
-                max_depth=cfg.max_depth, hist_method=hm,
-                tile_leaves=cfg.tile_leaves,
-                hist_block=cfg.hist_block,
-                exact=cfg.tree_growth_mode == "exact",
-                with_categorical=ts.has_categorical,
-                with_monotone=self._with_monotone,
-                mono_mode=self._mono_mode,
-                mono_features=self._mono_features,
-                extra_trees=cfg.extra_trees,
-                hist_subtraction=cfg.hist_subtraction,
-                vote_top_k=cfg.top_k, hist_dp=self._hist_dp)
+                **self._parallel_grow_statics(hm))
         sub = self._bag_sub
         has_sp = getattr(ts, "has_sparse_cols", False)
         return grow_tree(
@@ -889,13 +1193,10 @@ class GBDT:
             sub_idx=sub[0] if sub else None,
             sub_bins=sub[1] if sub else None,
             sub_binsT=sub[2] if sub else None,
-            with_interactions=self._with_interactions,
             interaction_groups=self._interaction_groups,
-            cegb_mode=self._cegb_mode,
             cegb_coupled=self._cegb_coupled,
             cegb_lazy_penalty=self._cegb_lazy,
             cegb_state=self._cegb_aux,
-            use_bynode=self._use_bynode,
             bynode_fraction=jnp.float32(cfg.feature_fraction_bynode)
             if self._use_bynode else None,
             rng_key=iter_key,
@@ -1055,14 +1356,17 @@ class GBDT:
                 f"non-finite — failing fast before the score caches are "
                 f"poisoned")
 
-    def _record_rows_streamed(self, rows_streamed: jax.Array) -> None:
-        """Accumulate a tree's histogram-pass row count (device add, no
-        sync); mirror into the profiling counters when TIMETAG is on (the
-        grow_tree scope already synced, so the fetch is cheap there)."""
+    def _record_aux_counters(self, aux: GrowAux) -> None:
+        """Accumulate a tree's histogram-pass row count and collective
+        receive volume (device adds, no sync); mirror into the profiling
+        counters when TIMETAG is on (the grow_tree scope already synced,
+        so the fetch is cheap there)."""
         from ..utils import profiling
-        self._rows_streamed_dev = self._rows_streamed_dev + rows_streamed
+        self._rows_streamed_dev = self._rows_streamed_dev + aux.rows_streamed
+        self._coll_bytes_dev = self._coll_bytes_dev + aux.coll_bytes
         if profiling.enabled():
-            profiling.counter("hist_rows_streamed", float(rows_streamed))
+            profiling.counter("hist_rows_streamed", float(aux.rows_streamed))
+            profiling.counter("hist_coll_bytes", float(aux.coll_bytes))
 
     @property
     def rows_streamed_total(self) -> float:
@@ -1074,6 +1378,17 @@ class GBDT:
     @property
     def rows_streamed_per_tree(self) -> float:
         return self.rows_streamed_total / max(len(self.trees), 1)
+
+    @property
+    def coll_bytes_total(self) -> float:
+        """Histogram-plane collective bytes received per device across all
+        trees so far (see GrowAux.coll_bytes; 0 for the serial and
+        feature learners). Reading this syncs the device accumulator."""
+        return float(self._coll_bytes_dev)
+
+    @property
+    def coll_bytes_per_iter(self) -> float:
+        return self.coll_bytes_total / max(self.iter, 1)
 
     def _finalize_tree(self, tree: TreeArrays, leaf_id: jax.Array,
                        class_idx: int) -> Tuple[TreeArrays, TreeArrays, bool]:
@@ -1144,20 +1459,18 @@ class GBDT:
                   linear: Optional[dict] = None,
                   t_host: Optional[TreeArrays] = None,
                   lazy: bool = False,
-                  new_score: Optional[jax.Array] = None) -> None:
+                  score_updated: bool = False) -> None:
         """Score updates for train (via leaf ids — no traversal needed) and
         valid sets (tree traversal on their binned matrices). ``linear``
         carries a fitted linear-leaf model: per-row train deltas plus the
         const/coeff tables (reference: Tree::AddPredictionToScore linear
         branch, tree.h). ``t_host`` is the already-fetched numpy mirror;
         with ``lazy`` the mirror is deferred (async copy, see host_trees);
-        ``new_score`` is the already-updated train score from the fused
-        step (the delta was computed inside the one-dispatch program)."""
+        ``score_updated`` means the train-score update already happened
+        inside the fused one-dispatch program (leaf_id may then be None)."""
         from .tree import leaf_values_of_rows
         lr = self.shrinkage_rate
-        if new_score is not None:
-            self.train_score = new_score
-        else:
+        if not score_updated:
             if linear is not None:
                 delta = jnp.asarray(linear["train_delta"] * lr)
             else:
@@ -1436,6 +1749,7 @@ class GBDT:
             "splitless_in_group": self._splitless_in_group,
             "lagged_stop": self._lagged_stop,
             "rows_streamed": float(self._rows_streamed_dev),
+            "coll_bytes": float(self._coll_bytes_dev),
             "best_score": dict(self.best_score),
             # the measured-auto histogram method is timing-dependent: the
             # resumed process must reuse the original run's choice or the
@@ -1477,6 +1791,7 @@ class GBDT:
         self._splitless_in_group = state["splitless_in_group"]
         self._lagged_stop = state["lagged_stop"]
         self._rows_streamed_dev = jnp.float32(state["rows_streamed"])
+        self._coll_bytes_dev = jnp.float32(state.get("coll_bytes", 0.0))
         self.best_score = dict(state["best_score"])
         if state.get("measured_hm") is not None:
             self._measured_hm = state["measured_hm"]
@@ -1494,21 +1809,11 @@ class GBDT:
 
     def _restore_bagging(self) -> None:
         """Recreate the bagging mask/subset active at the restored
-        iteration: a mask drawn at the last refresh iteration persists
-        across the whole bagging period, so a mid-period resume re-draws
-        it from the same fold_in(refresh_iter) key (the draw is
-        deterministic in the iteration — no RNG state to persist)."""
-        cfg = self.config
-        if not self._need_bagging or cfg.bagging_freq <= 0 or self.iter <= 0:
-            return
-        if self.iter % cfg.bagging_freq == 0:
-            return   # the next iteration re-draws anyway
-        saved = self.iter
-        try:
-            self.iter = (saved // cfg.bagging_freq) * cfg.bagging_freq
-            self._update_bagging()
-        finally:
-            self.iter = saved
+        iteration: the draw is keyed on the period-start iteration (see
+        _update_bagging), so marking the host state stale makes the next
+        iteration re-derive the exact mid-period mask — no RNG state to
+        persist."""
+        self._bag_stale = True
 
     # ------------------------------------------------------------- eval
     def eval_set(self, feval=None) -> List[Tuple[str, str, float, bool]]:

@@ -228,6 +228,16 @@ class GrowAux(NamedTuple):
     row_used: jax.Array      # [N, F] bool or [1, 1] dummy (CEGB lazy)
     rows_streamed: jax.Array  # f32 scalar: rows read by this tree's
                               # histogram passes (compaction telemetry)
+    coll_bytes: jax.Array    # f32 scalar: histogram-plane collective bytes
+                             # RECEIVED per device for this tree (the
+                             # psum_scatter'd tiles of the data learner /
+                             # the vote + elected-histogram psums of the
+                             # voting learner; best-split syncs are O(L)
+                             # scalars and not counted). Row-count
+                             # independent by construction — the volume
+                             # the reference's ReduceScatter moves
+                             # (data_parallel_tree_learner.cpp:184-186).
+                             # 0 for the serial / feature learners.
 
 
 class GrowState(NamedTuple):
@@ -259,6 +269,8 @@ class GrowState(NamedTuple):
     num_leaves: jax.Array    # int32
     rounds: jax.Array        # int32
     rows_streamed: jax.Array  # f32: rows read by histogram passes so far
+    coll_bytes: jax.Array    # f32: collective bytes received so far (see
+                             # GrowAux.coll_bytes)
 
 
 def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
@@ -833,6 +845,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             num_leaves=jnp.int32(1),
             rounds=jnp.int32(0),
             rows_streamed=jnp.float32(0.0),
+            coll_bytes=jnp.float32(0.0),
         )
 
     def active_mask(state: GrowState) -> jax.Array:
@@ -1018,6 +1031,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                               # the full leaf-id vector
         if f_sp:
             tile = combine_sparse(tile, sel, hist_leaf_ids, stats)
+        # collective-volume accounting (GrowAux.coll_bytes): logical
+        # histogram payload received per device per pass — a STATIC
+        # quantity (tile shapes are static), so the counter costs one
+        # scalar add and is independent of row count by construction
+        hist_itemsize = 4 if quant8 else (8 if hist_dp else 4)
+        tile_bytes = int(np.prod(tile.shape)) * hist_itemsize
+        coll = 0.0
         if dp_scatter:
             # the reference DP learner reduce-scatters histograms so each
             # machine receives only its owned features' global sums
@@ -1025,8 +1045,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             # full allreduce
             tile = jax.lax.psum_scatter(tile, axis_name,
                                         scatter_dimension=1, tiled=True)
+            coll = tile_bytes / feature_shards
         elif axis_name is not None and not voting:
             tile = jax.lax.psum(tile, axis_name)
+            coll = tile_bytes
         if quant8:
             # collectives ran on exact int32 sums; dequantize once here
             tile = tile.astype(hist_dtype) * q_scale[None, None, None, :]
@@ -1052,7 +1074,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             hist_valid=state.hist_valid | resolved,
             parent_hist=state.parent_hist & ~resolved,
             rounds=state.rounds + 1,
-            rows_streamed=state.rows_streamed + streamed)
+            rows_streamed=state.rows_streamed + streamed,
+            coll_bytes=state.coll_bytes + jnp.float32(coll))
 
     def intermediate_bounds(state: GrowState) -> GrowState:
         """Exact per-leaf output bounds from ALL current leaf outputs and
@@ -1121,6 +1144,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
 
         search_hist = state.hist
         search_fmask = fmask
+        coll = 0.0
         if voting:
             # PV-tree election (voting_parallel_tree_learner.cpp:137-182):
             # local per-feature gains from LOCAL histograms and local leaf
@@ -1159,6 +1183,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             fm2 = fmask if fmask.ndim == 2 else jnp.broadcast_to(
                 fmask[None, :], (L, f))
             search_fmask = (fm2.astype(bool) & elected).astype(jnp.float32)
+            # GlobalVoting communication: the vote tally allreduce plus the
+            # elected columns' histogram sum (CopyLocalHistogram analog) —
+            # the only histogram-plane collectives in the voting learner
+            hist_itemsize = 8 if hist_dp else 4
+            coll = (L * f * 4
+                    + L * k2 * num_bins * int(state.hist.shape[3])
+                    * hist_itemsize)
 
         best = find_best_splits(
             search_hist, state.leaf_sum_g, state.leaf_sum_h,
@@ -1179,7 +1210,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             best = best._replace(feature=best.feature + off)
             best = sync_best_splits(best, feature_axis_name)
         num_leaves_before = state.num_leaves
-        state = state._replace(best=best, rounds=state.rounds + 1)
+        state = state._replace(best=best, rounds=state.rounds + 1,
+                               coll_bytes=state.coll_bytes
+                               + jnp.float32(coll))
 
         gain_eff = jnp.where(active_mask(state) & state.hist_valid
                              & ~state.leaf_dead, best.gain, NEG_INF)
@@ -1414,5 +1447,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # global rows per tree across the row shards (each shard counted
         # only its local rows)
         rows_streamed = jax.lax.psum(rows_streamed, axis_name)
+    # coll_bytes is already the per-device receive volume and identical on
+    # every shard — no psum (a psum would scale it by the mesh size)
     return state.tree, state.leaf_id, GrowAux(state.used_split,
-                                              state.row_used, rows_streamed)
+                                              state.row_used, rows_streamed,
+                                              state.coll_bytes)

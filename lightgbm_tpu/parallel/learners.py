@@ -67,6 +67,20 @@ def _pad_rows(n_pad, *arrays):
     return out
 
 
+def pad_bundle_meta(bundle_meta, f_pad: int):
+    """Pad EFB bundle metadata with inert (non-bundle) columns whose single
+    segment spans the full bin range — the grower slices bundle rows by the
+    PADDED feature offset, so misaligned rows would corrupt real columns."""
+    b = bundle_meta.seg_lo.shape[1]
+    return type(bundle_meta)(
+        seg_lo=jnp.pad(bundle_meta.seg_lo, ((0, f_pad), (0, 0))),
+        seg_hi=jnp.pad(bundle_meta.seg_hi, ((0, f_pad), (0, 0)),
+                       constant_values=b - 1),
+        is_bundle=jnp.pad(bundle_meta.is_bundle, (0, f_pad)),
+        fwd_ok=jnp.pad(bundle_meta.fwd_ok, ((0, f_pad), (0, 0))),
+        rev_ok=jnp.pad(bundle_meta.rev_ok, ((0, f_pad), (0, 0))))
+
+
 def _pad_features(meta: FeatureMeta, f_pad: int) -> FeatureMeta:
     """Pad per-feature metadata with inert features (2 bins, no missing,
     numerical, unconstrained) — they are masked off via feature_mask."""
@@ -135,9 +149,73 @@ class ParallelGrower:
         leaf_spec = P() if gather_leaf else row
         in_specs = (row2, row, row, row, P(), P(), P(), P(), extras_spec,
                     P())
-        out_specs = (P(), leaf_spec, GrowAux(P(), P(), P()))
+        out_specs = (P(), leaf_spec, GrowAux(P(), P(), P(), P()))
         return _shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                           out_specs=out_specs)
+
+    def pad_replicated_inputs(self, bins, binsT, meta, missing_bin,
+                              bundle_meta):
+        """Pad the dataset-constant arrays of the replicated (single-
+        controller) path to mesh-divisible shapes — the ONE definition of
+        the row/feature padding rules, shared by the per-call unfused
+        ``__call__`` below and the fused step's build-once bindings
+        (models/gbdt.py _fused_parallel_bindings), so the two paths
+        cannot drift. Returns ``(bins, binsT, meta, missing_bin,
+        bundle_meta, n_pad, f_pad)``."""
+        n, f = bins.shape
+        d = self.ndev
+        n_pad = (-n) % d if self.mode in ("data", "voting") else 0
+        f_pad = (-f) % d if self.mode in ("data", "feature") else 0
+        if n_pad:
+            bins = jnp.pad(bins, ((0, n_pad), (0, 0)))
+            if binsT is not None:
+                binsT = jnp.pad(binsT, ((0, 0), (0, n_pad)))
+        if f_pad:
+            bins = jnp.pad(bins, ((0, 0), (0, f_pad)))
+            meta = _pad_features(meta, f_pad)
+            missing_bin = jnp.pad(missing_bin, (0, f_pad),
+                                  constant_values=-1)
+            if binsT is not None:
+                binsT = jnp.pad(binsT, ((0, f_pad), (0, 0)))
+            if bundle_meta is not None:
+                bundle_meta = pad_bundle_meta(bundle_meta, f_pad)
+        return bins, binsT, meta, missing_bin, bundle_meta, n_pad, f_pad
+
+    def build_extras(self, binsT, bundle_meta, forced_splits):
+        """Assemble the optional-operand dict + its PartitionSpecs for
+        the shard fn (the single definition of the binsT/bundle/forced
+        wiring both call paths share)."""
+        extras, extras_spec = {}, {}
+        rows_sharded = self.mode in ("data", "voting")
+        if binsT is not None:
+            extras["binsT"] = binsT
+            extras_spec["binsT"] = (P(None, self.axis) if rows_sharded
+                                    else P())
+        if bundle_meta is not None:
+            extras["bundle"] = bundle_meta
+            extras_spec["bundle"] = type(bundle_meta)(
+                *(P() for _ in bundle_meta))
+        if forced_splits is not None:
+            extras["forced"] = forced_splits
+            extras_spec["forced"] = tuple(P() for _ in forced_splits)
+        return extras, extras_spec
+
+    def get_shard_fn(self, extras_spec: dict, grow_kwargs: tuple,
+                     pre_part: bool = False):
+        """The cached shard_map'd grower for a static configuration — the
+        single compile cache BOTH call paths share: the unfused per-phase
+        ``__call__`` below and the fused one-dispatch iteration
+        (models/gbdt.py ``_fused_step_fn``) embed the same program, so a
+        config admitted to the fused path never compiles the grower
+        twice."""
+        key = (("prepart",) if pre_part else ()) + (
+            frozenset(extras_spec), grow_kwargs)
+        shard = self._cache.get(key)
+        if shard is None:
+            shard = self._build(extras_spec, grow_kwargs,
+                                pre_part=pre_part)
+            self._cache[key] = shard
+        return shard
 
     def _to_global(self, arr, spec, key=None):
         """Multi-controller: build a GLOBAL array from this process's full
@@ -241,21 +319,7 @@ class ParallelGrower:
                         binsT, colT,
                         lambda b: jnp.pad(b, ((0, f_pad), (0, 0))))
                 if bundle_meta is not None:
-                    # inert padded columns, like the replicated path below:
-                    # the grower slices bundle rows by the PADDED feature
-                    # offset, so misaligned rows would corrupt real columns
-                    b = bundle_meta.seg_lo.shape[1]
-                    bundle_meta = type(bundle_meta)(
-                        seg_lo=jnp.pad(bundle_meta.seg_lo,
-                                       ((0, f_pad), (0, 0))),
-                        seg_hi=jnp.pad(bundle_meta.seg_hi,
-                                       ((0, f_pad), (0, 0)),
-                                       constant_values=b - 1),
-                        is_bundle=jnp.pad(bundle_meta.is_bundle, (0, f_pad)),
-                        fwd_ok=jnp.pad(bundle_meta.fwd_ok,
-                                       ((0, f_pad), (0, 0))),
-                        rev_ok=jnp.pad(bundle_meta.rev_ok,
-                                       ((0, f_pad), (0, 0))))
+                    bundle_meta = pad_bundle_meta(bundle_meta, f_pad)
             extras = {}
             extras_spec = {}
             if binsT is not None:
@@ -275,14 +339,9 @@ class ParallelGrower:
                 extras_spec["forced"] = tuple(P() for _ in forced_splits)
             if rng_key is None:
                 rng_key = jax.random.PRNGKey(0)
-            key = ("prepart", frozenset(extras),
-                   tuple(sorted(grow_kwargs.items())))
-            shard = self._cache.get(key)
-            if shard is None:
-                shard = self._build(extras_spec,
-                                    tuple(sorted(grow_kwargs.items())),
-                                    pre_part=True)
-                self._cache[key] = shard
+            shard = self.get_shard_fn(extras_spec,
+                                      tuple(sorted(grow_kwargs.items())),
+                                      pre_part=True)
             tree, leaf_id, aux = shard(bins, grad, hess, sample_mask, meta,
                                        params, feature_mask, missing_bin,
                                        extras, rng_key)
@@ -292,34 +351,14 @@ class ParallelGrower:
         orig_bins, orig_binsT = bins, binsT
         orig_meta, orig_missing_bin = meta, missing_bin
         orig_bundle, orig_forced = bundle_meta, forced_splits
-        # pad rows (data/voting shard rows) and features (data/feature
-        # shard feature ownership) to multiples of the mesh size
-        n_pad = (-n) % d if self.mode in ("data", "voting") else 0
-        f_pad = (-f) % d if self.mode in ("data", "feature") else 0
+        (bins, binsT, meta, missing_bin, bundle_meta,
+         n_pad, f_pad) = self.pad_replicated_inputs(
+            bins, binsT, meta, missing_bin, bundle_meta)
         if n_pad:
-            bins, grad, hess, sample_mask = _pad_rows(
-                n_pad, bins, grad, hess, sample_mask)
-            if binsT is not None:
-                binsT = jnp.pad(binsT, ((0, 0), (0, n_pad)))
+            _, grad, hess, sample_mask = _pad_rows(n_pad, None, grad, hess,
+                                                   sample_mask)
         if f_pad:
-            bins = jnp.pad(bins, ((0, 0), (0, f_pad)))
-            meta = _pad_features(meta, f_pad)
             feature_mask = jnp.pad(feature_mask, (0, f_pad))
-            missing_bin = jnp.pad(missing_bin, (0, f_pad),
-                                  constant_values=-1)
-            if binsT is not None:
-                binsT = jnp.pad(binsT, ((0, f_pad), (0, 0)))
-            if bundle_meta is not None:
-                # inert padded columns: regular (non-bundle) with the full
-                # bin range as their single segment
-                b = bundle_meta.seg_lo.shape[1]
-                bundle_meta = type(bundle_meta)(
-                    seg_lo=jnp.pad(bundle_meta.seg_lo, ((0, f_pad), (0, 0))),
-                    seg_hi=jnp.pad(bundle_meta.seg_hi, ((0, f_pad), (0, 0)),
-                                   constant_values=b - 1),
-                    is_bundle=jnp.pad(bundle_meta.is_bundle, (0, f_pad)),
-                    fwd_ok=jnp.pad(bundle_meta.fwd_ok, ((0, f_pad), (0, 0))),
-                    rev_ok=jnp.pad(bundle_meta.rev_ok, ((0, f_pad), (0, 0))))
         if rng_key is None:
             rng_key = jax.random.PRNGKey(0)
         if jax.process_count() > 1:
@@ -337,37 +376,24 @@ class ParallelGrower:
             missing_bin = self._to_global(missing_bin, P(),
                                           key=orig_missing_bin)
 
-        extras = {}
-        extras_spec = {}
-        rows_sharded = self.mode in ("data", "voting")
+        extras, extras_spec = self.build_extras(binsT, bundle_meta,
+                                                forced_splits)
         multiproc = jax.process_count() > 1
-        if binsT is not None:
-            colT = P(None, self.axis) if rows_sharded else P()
-            extras["binsT"] = self._to_global(binsT, colT, key=orig_binsT) \
-                if multiproc else binsT
-            extras_spec["binsT"] = colT
-        if bundle_meta is not None:
-            if multiproc:
-                bundle_meta = type(bundle_meta)(
+        if multiproc:
+            if "binsT" in extras:
+                extras["binsT"] = self._to_global(
+                    extras["binsT"], extras_spec["binsT"], key=orig_binsT)
+            if "bundle" in extras:
+                extras["bundle"] = type(bundle_meta)(
                     *(self._to_global(a, P(), key=ka)
-                      for a, ka in zip(bundle_meta, orig_bundle)))
-            extras["bundle"] = bundle_meta
-            extras_spec["bundle"] = type(bundle_meta)(
-                *(P() for _ in bundle_meta))
-        if forced_splits is not None:
-            if multiproc:
-                forced_splits = tuple(
+                      for a, ka in zip(extras["bundle"], orig_bundle)))
+            if "forced" in extras:
+                extras["forced"] = tuple(
                     self._to_global(a, P(), key=ka)
-                    for a, ka in zip(forced_splits, orig_forced))
-            extras["forced"] = forced_splits
-            extras_spec["forced"] = tuple(P() for _ in forced_splits)
+                    for a, ka in zip(extras["forced"], orig_forced))
 
-        key = (frozenset(extras), tuple(sorted(grow_kwargs.items())))
-        shard = self._cache.get(key)
-        if shard is None:
-            shard = self._build(extras_spec,
-                                tuple(sorted(grow_kwargs.items())))
-            self._cache[key] = shard
+        shard = self.get_shard_fn(extras_spec,
+                                  tuple(sorted(grow_kwargs.items())))
         tree, leaf_id, aux = shard(bins, grad, hess, sample_mask, meta,
                                    params, feature_mask, missing_bin,
                                    extras, rng_key)

@@ -120,6 +120,154 @@ class timer_sync:
         return self._cm.__exit__(*exc)
 
 
+# ------------------------------------------------- dispatch / host-sync
+# Always-on (TIMETAG-independent) counters for compiled-program dispatches
+# and explicit host<->device transfers — the telemetry behind bench.py's
+# ``dispatches_per_iter`` / ``host_bytes_per_iter`` JSON fields and the
+# fused-iteration regression tests. Each dispatch and each device_get is a
+# transport round trip through a TPU tunnel (~75-93 ms RTT observed), so
+# the per-iteration counts ARE the non-histogram overhead budget.
+#
+# Installed by hooking the funnels every dispatch/transfer goes through:
+#   - ``pxla.ExecuteReplicated.__call__``: every compiled-program execution
+#     (jitted calls AND eager op dispatches both end here);
+#   - ``jax.device_get``: explicit device->host fetches (the tree-mirror
+#     and score-cache reads in this codebase all use it);
+#   - ``pxla.batched_device_put``: host->device array uploads (bytes are
+#     counted only for host-resident inputs; device-to-device moves are
+#     not transfers).
+# jax's C++ pjit fastpath executes cached programs WITHOUT entering
+# Python, so installing the hook also forces every call back through the
+# Python dispatch path (``_get_fastpath_data -> None`` + a cache clear).
+# That adds a small per-dispatch Python overhead (tens of µs — noise next
+# to the ms-scale iterations this instrument measures, but NOT free):
+# telemetry is a measurement MODE, installed explicitly by bench.py and
+# the regression tests, never by library code.
+# The hooks are version-guarded: on a jax without these internals
+# ``install_dispatch_hook`` returns False and the counters stay at zero.
+
+_disp: Dict[str, int] = {"dispatches": 0, "device_gets": 0,
+                         "d2h_bytes": 0, "h2d_bytes": 0}
+_hook_state: Optional[bool] = None   # None = never attempted
+_hook_originals: Optional[tuple] = None
+
+
+def install_dispatch_hook() -> bool:
+    """Install the dispatch/transfer counting hooks (idempotent). Returns
+    whether the counters are live. ``uninstall_dispatch_hook`` restores
+    the originals (tests use it so the fastpath bypass doesn't tax the
+    rest of the suite)."""
+    global _hook_state, _hook_originals
+    if _hook_state is not None:
+        return _hook_state
+    try:
+        import jax
+        from jax._src.interpreters import pxla
+
+        orig_call = pxla.ExecuteReplicated.__call__
+
+        def _counting_call(self, *args):
+            _disp["dispatches"] += 1
+            return orig_call(self, *args)
+
+        orig_get = jax.device_get
+
+        def _counting_get(x):
+            _disp["device_gets"] += 1
+            try:
+                for leaf in jax.tree_util.tree_leaves(x):
+                    if isinstance(leaf, jax.Array):
+                        _disp["d2h_bytes"] += int(leaf.nbytes)
+            except Exception:
+                pass
+            return orig_get(x)
+
+        orig_bdp = pxla.batched_device_put
+
+        def _counting_bdp(*args, **kwargs):
+            # signature-tolerant passthrough (private jax API): count
+            # bytes only when the shard-list operand is recognizable, so
+            # signature drift degrades the counter, never the upload
+            try:
+                xs = kwargs.get("xs", args[2] if len(args) > 2 else ())
+                _disp["h2d_bytes"] += sum(
+                    int(getattr(x, "nbytes", 0)) for x in xs
+                    if not isinstance(x, jax.Array))
+            except Exception:
+                pass
+            return orig_bdp(*args, **kwargs)
+
+        # disable the C++ pjit fastpath so cached executions re-enter
+        # Python (and thus ExecuteReplicated); clear caches so fastpath
+        # entries established before the hook don't bypass it
+        from jax._src import pjit as pjit_mod
+        if not hasattr(pjit_mod, "_get_fastpath_data"):
+            raise AttributeError("no _get_fastpath_data")
+
+        def _no_fastpath(*args, **kwargs):
+            return None
+
+        _hook_originals = (orig_call, orig_get, orig_bdp,
+                           pjit_mod._get_fastpath_data)
+        try:
+            pxla.ExecuteReplicated.__call__ = _counting_call
+            jax.device_get = _counting_get
+            pxla.batched_device_put = _counting_bdp
+            pjit_mod._get_fastpath_data = _no_fastpath
+            jax.clear_caches()
+        except Exception:
+            # unwind a partial install: leaving the fastpath bypass (or
+            # any hook) behind while reporting "not live" would tax every
+            # dispatch for the process lifetime with no way to remove it
+            orig = _hook_originals
+            pxla.ExecuteReplicated.__call__ = orig[0]
+            jax.device_get = orig[1]
+            pxla.batched_device_put = orig[2]
+            pjit_mod._get_fastpath_data = orig[3]
+            _hook_originals = None
+            raise
+        _hook_state = True
+    except Exception:
+        _hook_state = False
+    return _hook_state
+
+
+def uninstall_dispatch_hook() -> None:
+    """Restore the hooked jax internals (and clear the jit caches so
+    entries established WITHOUT fastpath data don't keep paying the
+    Python round trip). Counter values are preserved."""
+    global _hook_state, _hook_originals
+    if not _hook_state or _hook_originals is None:
+        return
+    import jax
+    from jax._src.interpreters import pxla
+    from jax._src import pjit as pjit_mod
+    orig_call, orig_get, orig_bdp, orig_fp = _hook_originals
+    pxla.ExecuteReplicated.__call__ = orig_call
+    jax.device_get = orig_get
+    pxla.batched_device_put = orig_bdp
+    pjit_mod._get_fastpath_data = orig_fp
+    jax.clear_caches()
+    _hook_state = None
+    _hook_originals = None
+
+
+def dispatch_stats() -> Dict[str, int]:
+    """Current cumulative counter values (all zero until
+    ``install_dispatch_hook`` succeeds). Monotonic — diff two snapshots to
+    scope a measurement (no reset, so concurrent readers never clobber
+    each other)."""
+    return dict(_disp)
+
+
+def dispatch_delta(before: Dict[str, int],
+                   after: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+    """Counter deltas since a ``dispatch_stats()`` snapshot."""
+    if after is None:
+        after = dispatch_stats()
+    return {k: after[k] - before.get(k, 0) for k in after}
+
+
 def table() -> str:
     """Aggregated per-scope wall-time table (reference: the USE_TIMETAG
     summary printed by ~Timer, common.h:970-990), followed by the named

@@ -39,7 +39,10 @@ def test_parser_buffer_no_trailing_newline():
     np.testing.assert_allclose(arr, [[7.5, 8], [9, 10.25]])
 
 
+@pytest.mark.slow
 def test_deep_tree_shap_no_recursion_error():
+    # ~11 s: deep-tree robustness edge; the SHAP correctness surface
+    # stays tier-1-covered by test_shap_fast.py
     """TreeSHAP must not consume Python stack proportional to tree depth
     (iterative walker): run it under a tiny recursion limit that the old
     per-node recursion could not survive, and check contributions sum to the

@@ -188,7 +188,10 @@ def test_pallas_q8_matches_onehot_q8(monkeypatch):
     np.testing.assert_array_equal(h_pl, h_ref)
 
 
+@pytest.mark.slow
 def test_quantized_training_quality():
+    # ~14 s: end-to-end quality check of the OPT-IN q8 mode (tier-1 keeps
+    # the q8 kernel-correctness tests in this file; quality rides slow)
     """End-to-end training with histogram_method=pallas_q8 (CPU fallback:
     onehot_q8 + the grower's int8 quantization) stays close to full
     precision — the quantized-gradient mode's quality contract."""

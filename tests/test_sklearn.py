@@ -44,7 +44,12 @@ def test_classifier_multiclass():
     assert (clf.predict(X) == y).mean() > 0.85
 
 
+@pytest.mark.slow
 def test_regressor_with_early_stopping():
+    # ~23 s, the heaviest test in this file (many-round fit + eval per
+    # round); the early-stopping machinery stays tier-1-covered by
+    # test_fault_tolerance.test_resume_restores_eval_history_and_early_stopping
+    # and the sklearn wrapper surface by this file's other tests
     X, y = make_regression(n_samples=600, n_features=8, noise=5.0,
                            random_state=1)
     reg = LGBMRegressor(n_estimators=100, num_leaves=15)
