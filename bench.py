@@ -148,6 +148,7 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True):
         _ = float(booster._boosting.train_score[0])
         phases["extra_rounds"] = time.time() - t0
         mark("extra_rounds")
+    predict_rps = predict_host_bytes = None
     if n_valid > 0:
         t0 = time.time()
         score = booster.predict(Xv, raw_score=True)
@@ -162,13 +163,31 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True):
                         / (npos * nneg))
         phases["valid_auc_predict"] = time.time() - t0
         mark(f"valid_auc_predict (auc={auc})")
+        # serving throughput: a SECOND (warm — the AUC predict above paid
+        # the engine compile) full-ensemble predict at the same shape,
+        # with dispatch/d2h telemetry: the inference-engine acceptance
+        # numbers (constant dispatches, [N, K]-only device->host bytes)
+        with profiling.dispatch_scope() as dd:
+            t0 = time.time()
+            _ = booster.predict(Xv, raw_score=True)
+            warm_sec = time.time() - t0
+        phases["warm_predict"] = warm_sec
+        predict_rps = n_valid / max(warm_sec, 1e-9)
+        if telemetry:
+            predict_host_bytes = dd["d2h_bytes"]
+            mark(f"warm_predict ({predict_rps:.0f} rows/s, "
+                 f"{dd['dispatches']} dispatches, "
+                 f"{predict_host_bytes} d2h bytes)")
+        else:
+            mark(f"warm_predict ({predict_rps:.0f} rows/s)")
     # compaction telemetry: rows read by histogram passes per tree (the
     # device-side accumulator syncs here, after the timed loop)
     rows_per_tree = booster._boosting.rows_streamed_per_tree
     mark(f"rows_streamed_per_tree={rows_per_tree:.0f} "
          f"(compaction={'on' if hist_compaction else 'off'})")
     return (sec_per_iter, phases, auc, max(args.rounds, done), rows_per_tree,
-            disp_per_iter, host_bytes_per_iter)
+            disp_per_iter, host_bytes_per_iter, predict_rps,
+            predict_host_bytes)
 
 
 def main():
@@ -239,7 +258,8 @@ def main():
             try:
                 print(f"# trying rows={rows} hist={hm}", file=sys.stderr)
                 (sec_per_iter, phases, auc, rounds_run, rows_per_tree,
-                 disp_per_iter, host_bytes_per_iter) = \
+                 disp_per_iter, host_bytes_per_iter, predict_rps,
+                 predict_host_bytes) = \
                     run_at_scale(rows, args, hist_method=hm)
                 used_rows = rows
                 used_method = hm
@@ -291,6 +311,13 @@ def main():
         if disp_per_iter is not None else None,
         "host_bytes_per_iter": round(host_bytes_per_iter, 1)
         if host_bytes_per_iter is not None else None,
+        # serving-path telemetry: warm full-ensemble predict throughput at
+        # the valid shape and its device->host bytes (the inference engine
+        # holds the latter at ~N*K*8: only the result crosses the tunnel)
+        "predict_rows_per_sec": round(predict_rps, 1)
+        if predict_rps is not None else None,
+        "predict_host_bytes": int(predict_host_bytes)
+        if predict_host_bytes is not None else None,
         # the main run has compaction ON (the default): these two fields
         # are the compacted numbers; the nocompact_* probe below supplies
         # the uncompacted side of the headroom comparison
@@ -320,7 +347,7 @@ def main():
     nc_sec = nc_rows = None
     if probe_headroom("nocompact"):
         try:
-            nc_sec, _, _, _, nc_rows, _, _ = run_at_scale(
+            nc_sec, _, _, _, nc_rows, _, _, _, _ = run_at_scale(
                 used_rows, args, hist_method=used_method,
                 hist_compaction=False)
             print(f"# nocompact probe: {nc_sec:.3f} s/iter, "
@@ -347,7 +374,7 @@ def main():
     if (used_method == "auto" and jax.default_backend() == "tpu"
             and probe_headroom("q8")):
         try:
-            q8_sec, q8_ph, q8_auc, _, _, _, _ = run_at_scale(
+            q8_sec, q8_ph, q8_auc, _, _, _, _, _, _ = run_at_scale(
                 used_rows, args, hist_method="pallas_q8")
             print(f"# q8 probe: {q8_sec:.3f} s/iter, auc={q8_auc}",
                   file=sys.stderr)
@@ -367,7 +394,7 @@ def main():
             and args.max_bin != 63 and probe_headroom("bin63")):
         try:
             b63_args = argparse.Namespace(**{**vars(args), "max_bin": 63})
-            b63_sec, b63_ph, b63_auc, _, _, _, _ = run_at_scale(
+            b63_sec, b63_ph, b63_auc, _, _, _, _, _, _ = run_at_scale(
                 used_rows, b63_args, hist_method="auto")
             print(f"# max_bin=63: {b63_sec:.3f} s/iter, "
                   f"auc={b63_auc}", file=sys.stderr)
@@ -380,7 +407,7 @@ def main():
         # the projected fastest configuration, with its own AUC readout
         if probe_headroom("bin63+q8"):
             try:
-                b63q8_sec, _, b63q8_auc, _, _, _, _ = run_at_scale(
+                b63q8_sec, _, b63q8_auc, _, _, _, _, _, _ = run_at_scale(
                     used_rows, b63_args, hist_method="pallas_q8")
                 print(f"# max_bin=63 + q8: {b63q8_sec:.3f} s/iter, "
                       f"auc={b63q8_auc}", file=sys.stderr)

@@ -125,6 +125,15 @@ class Booster:
                 pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0,
                 **kwargs) -> np.ndarray:
+        """Predict on new data (reference: basic.py Booster.predict).
+
+        Serving runs on the device-resident inference engine
+        (models/predict_engine.py): one ensemble-scan dispatch with f64
+        accumulation on device, returning only the [N, K] result —
+        batch shapes are bucketed so varying sizes reuse compiled
+        programs. Tuned by the ``predict_bucket_min_rows`` /
+        ``predict_chunk_rows`` (streaming) / ``predict_sharded``
+        (multi-device row sharding) / ``predict_accum`` params."""
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 else -1
         if pred_leaf:
@@ -302,6 +311,7 @@ class Booster:
                     arr[dst * k + c] = orig[src * k + c]
         b._mt_cache.clear()
         b._stacked_cache = None
+        b._engine_cache.clear()   # stacked order changed under the engine
         b._contrib_tree_cache = None
         return self
 

@@ -344,6 +344,26 @@ class Config:
     # the reference side of the fused-vs-unfused bit-parity test suite.
     fused_iteration: bool = True
 
+    # Inference engine (models/predict_engine.py; no reference analog)
+    # row-padding floor of the predict compile cache: batch rows pad up to
+    # power-of-two buckets >= this, so varying serving batch sizes reuse a
+    # handful of compiled programs instead of recompiling per distinct N
+    predict_bucket_min_rows: int = 1024
+    # chunked streaming predict: inputs larger than this many rows run in
+    # row chunks so the device never holds more than one chunk of the
+    # feature matrix (0 = auto, ~4M-row chunks)
+    predict_chunk_rows: int = 0
+    # row-shard full-ensemble prediction over all visible devices via
+    # shard_map (trees replicated, rows split; per-row accumulation order
+    # is unchanged so results are bit-identical to single-device)
+    predict_sharded: bool = False
+    # ensemble accumulation precision: auto|float64|compensated|float32.
+    # auto/float64 sums tree outputs in float64 on device IN TREE ORDER —
+    # bit-identical to the host-f64 reference accumulation; compensated =
+    # two-float (Kahan) f32 for backends without usable f64; float32 =
+    # fastest, least precise
+    predict_accum: str = "auto"
+
     def __post_init__(self):
         if self.seed is not None:
             # seed derives the sub-seeds exactly like config.cpp:150-161

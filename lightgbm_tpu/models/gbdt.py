@@ -33,8 +33,7 @@ from ..ops.split import SplitParams
 from ..utils import log
 from .grower import GrowAux, grow_tree
 from .tree import (HostTree, TreeArrays, predict_leaf_bins,
-                   predict_leaves_stacked, predict_value_bins,
-                   predict_values_stacked, stack_trees)
+                   predict_leaf_bins_depth, predict_value_bins, stack_trees)
 
 
 import functools
@@ -115,6 +114,26 @@ def _apply_score_delta(score: jax.Array, delta: jax.Array) -> jax.Array:
     return score + (delta.T if delta.ndim == 2 else delta)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   static_argnames=("depth", "kk"))
+def _apply_valid_tree(score: jax.Array, tree: TreeArrays, bins: jax.Array,
+                      missing_bin: jax.Array, class_idx, depth: int,
+                      kk: int) -> jax.Array:
+    """Per-iteration valid-score update as ONE compiled program with the
+    score cache DONATED (in-place add): depth-bounded traversal + leaf
+    gather + add — the training-time eval leg of the inference engine.
+    Previously this was an eager predict_value_bins per tree per valid
+    set (an op-by-op dispatch chain); now eval-on-valid costs one
+    dispatch. No multiply feeds the add (leaf values arrive pre-shrunk),
+    so there is no FMA-contraction parity hazard (see _apply_score_delta)
+    and the result is bit-identical to the eager path."""
+    leaf = predict_leaf_bins_depth(tree, bins, missing_bin, depth)
+    delta = tree.leaf_value[leaf]
+    if kk > 1:
+        return score.at[:, class_idx].add(delta)
+    return score + delta
+
+
 def _shrink_tree(tree: TreeArrays, lr: float) -> TreeArrays:
     """Apply the learning rate to a tree's value-bearing fields
     (Tree::Shrinkage, tree.h:187). Works on device or host-mirrored
@@ -167,6 +186,10 @@ class GBDT:
         self._mt_cache: Dict[int, object] = {}   # host-tree idx -> ModelTree
         self._valid_raw_cache: Dict[int, jax.Array] = {}
         self._stacked_cache: Optional[Tuple[int, TreeArrays]] = None
+        # device inference engines keyed by tree count; each entry records
+        # the stacked pytree it was built from, so a stacked-cache refresh
+        # (new trees, shuffle, rollback, restore) invalidates it by identity
+        self._engine_cache: Dict[int, Tuple[TreeArrays, object]] = {}
         self.valid_sets: List[Dataset] = []
         self.valid_names: List[str] = []
         self._valid_scores: List[jax.Array] = []
@@ -1540,7 +1563,14 @@ class GBDT:
             elif mt is not None:
                 vdelta = jnp.asarray(mt.predict(vs.raw_data_np).astype(np.float32))
             else:
-                vdelta = predict_value_bins(tree, vs.bins, vs.missing_bin)
+                # inference-engine leg of training-time eval: traversal +
+                # donated in-place add as ONE compiled program per valid
+                # set (bit-identical to the eager per-op path it replaced)
+                self._valid_scores[i] = _apply_valid_tree(
+                    self._valid_scores[i], tree, vs.bins, vs.missing_bin,
+                    np.int32(class_idx), depth=self._traversal_depth(),
+                    kk=self.num_tree_per_iteration)
+                continue
             if self.num_tree_per_iteration > 1:
                 self._valid_scores[i] = self._valid_scores[i].at[:, class_idx].add(vdelta)
             else:
@@ -1802,6 +1832,7 @@ class GBDT:
             self.loaded = load_model(state["loaded_model_text"], self.config)
             self.loaded_iters = int(state["loaded_iters"])
         self._stacked_cache = None
+        self._engine_cache.clear()
         self._mt_cache.clear()
         self._contrib_tree_cache = None
         self._bag_frac = None
@@ -1895,12 +1926,83 @@ class GBDT:
         self._stacked_cache = (n_trees, stacked)
         return stacked
 
+    # ------------------------------------------------- inference engine
+    def _traversal_depth(self) -> int:
+        """STATIC trip-count bound for depth-bounded traversal DURING
+        training (no host sync to measure the freshly grown tree): a
+        leaf's depth is bounded by max_depth when set, and by
+        num_leaves - 1 always."""
+        cfg = self.config
+        if cfg.max_depth and cfg.max_depth > 0:
+            return min(cfg.max_depth, cfg.num_leaves - 1)
+        return cfg.num_leaves - 1
+
+    def _ensemble_depth(self, n_trees: int) -> int:
+        """True max leaf depth over the first n_trees host mirrors — the
+        engine's static fori_loop trip count, measured ONCE at engine
+        build (not per predict)."""
+        from .predict_engine import host_tree_depth
+        d = 0
+        for ht in self.host_trees[:n_trees]:
+            d = max(d, host_tree_depth(ht.left_child, ht.right_child,
+                                       ht.num_leaves))
+        return d
+
+    def _predict_engine(self, num_iteration: Optional[int] = None):
+        """Cached device inference engine over the stacked ensemble (see
+        models/predict_engine.py): depth-bounded traversal + on-device
+        f64 accumulation + shape-bucketed compile cache + chunked /
+        sharded serving. Invalidated by identity against the stacked
+        cache, so anything that refreshes the stack (new trees, shuffle,
+        rollback, checkpoint restore) rebuilds the engine."""
+        from .predict_engine import PredictEngine
+        stacked = self._stacked(num_iteration)
+        if stacked is None:
+            return None
+        nt = int(stacked.leaf_value.shape[0])
+        hit = self._engine_cache.get(nt)
+        if hit is not None and hit[0] is stacked:
+            return hit[1]
+        cfg = self.config
+        biases = None
+        if len(self.tree_bias) >= nt:
+            b = np.asarray(self.tree_bias[:nt], np.float64)
+            if b.size and np.any(b):
+                biases = b
+        eng = PredictEngine(
+            stacked, self.num_tree_per_iteration, nt,
+            self._ensemble_depth(nt), biases=biases,
+            accum=cfg.predict_accum,
+            bucket_min_rows=cfg.predict_bucket_min_rows,
+            chunk_rows=cfg.predict_chunk_rows,
+            sharded=cfg.predict_sharded)
+        if len(self._engine_cache) >= 2:
+            self._engine_cache.pop(next(iter(self._engine_cache)))
+        self._engine_cache[nt] = (stacked, eng)
+        return eng
+
+    def _convert_output_jit(self):
+        """The objective's output conversion as ONE jitted program (the
+        eager convert_output is an op-by-op dispatch chain). Input is
+        cast to the dtype the legacy host path fed it (f32 unless x64 is
+        on globally), so converted outputs keep their historical bits."""
+        obj = self.objective
+        x64 = bool(jax.config.jax_enable_x64)
+        if getattr(self, "_convert_jit_key", None) == (id(obj), x64):
+            return self._convert_jit
+        dt = jnp.float64 if x64 else jnp.float32
+        self._convert_jit = jax.jit(lambda r: obj.convert_output(
+            r.astype(dt)))
+        # keyed on the objective AND the x64 flag: a flag flip must not
+        # serve a stale f32-casting program (obj retained via the closure)
+        self._convert_jit_key = (id(obj), x64)
+        return self._convert_jit
+
     def score_dataset(self, ds) -> np.ndarray:
         """Raw scores for a train-aligned Dataset via traversal of its
         BINNED matrix (the mechanism Booster.eval uses for a dataset whose
         raw features were freed — the reference scores added valid sets
         through the same binned representation, score_updater.hpp)."""
-        from .tree import predict_values_stacked
         ds.construct()
         ts = self.train_set
         if ts is not None and ds is not ts and ds.reference is not ts \
@@ -1931,14 +2033,14 @@ class GBDT:
             out = np.asarray(init, np.float64).reshape(n, k).copy()
         stacked = self._stacked()
         if stacked is not None:
-            vals = np.asarray(predict_values_stacked(
-                stacked, self._traversal_bins(ds), ds.missing_bin),
-                np.float64)                                     # [T, n]
-            biases = np.asarray(self.tree_bias, np.float64)[:, None]
-            vals = vals - biases if len(self.tree_bias) == vals.shape[0] \
-                else vals
-            for t in range(vals.shape[0]):
-                out[:, t % k] += vals[t]
+            # device-resident engine: traversal + per-tree bias subtraction
+            # + f64 accumulation IN TREE ORDER all on device — only the
+            # [n, K] result crosses to the host (the [T, n] per-tree value
+            # matrix never does), bit-identical to the former host loop
+            eng = self._predict_engine()
+            base = out if k > 1 else out[:, 0]
+            return eng.predict(self._traversal_bins(ds), ds.missing_bin,
+                               base=base)
         return out if k > 1 else out[:, 0]
 
     def _traversal_bins(self, ds) -> jax.Array:
@@ -1984,7 +2086,8 @@ class GBDT:
                     start_iteration: int = 0,
                     pred_early_stop: bool = False,
                     pred_early_stop_freq: int = 10,
-                    pred_early_stop_margin: float = 10.0) -> np.ndarray:
+                    pred_early_stop_margin: float = 10.0,
+                    _postprocess=None) -> np.ndarray:
         """Raw scores for new raw-feature data (binned via the train mappers;
         the analog of GBDT::PredictRaw, gbdt_prediction.cpp:13-53). The
         boost-from-average init score lives inside the first tree's leaves
@@ -2033,7 +2136,7 @@ class GBDT:
                     if not active.any():
                         break
             return out if k > 1 else out[:, 0]
-        bins = jnp.asarray(self.train_set.bin_new_data(X))
+        bins = self.train_set.bin_new_data(X)
         k = self.num_tree_per_iteration
         n = bins.shape[0]
         total_iters = self.loaded_iters + len(self.trees) // k
@@ -2064,37 +2167,93 @@ class GBDT:
                 active &= ~_early_stop_mask(out, k, pred_early_stop_margin)
                 if not active.any():
                     return out if k > 1 else out[:, 0]
-        # own trees: a handful of batched device dispatches (bounded by the
-        # early-stop check period and a [t, n] buffer cap) via the stacked
-        # ensemble scan — not one round trip per tree. Per-tree values come
-        # back and accumulate in float64 in tree order, bit-identical to the
-        # per-tree path.
+        # own trees: the device-resident inference engine — depth-bounded
+        # traversal + f64 accumulation IN TREE ORDER on device, so only
+        # the [n, K] result crosses to the host (bit-identical to the
+        # former host per-tree accumulation; the [T, n] per-tree value
+        # matrix never leaves the device)
         if it < end_iter:
-            stacked = self._stacked()
-            max_chunk_iters = _chunk_iters_cap(n, k, itemsize=8)
-            while it < end_iter:
-                ce = min(end_iter, it + max_chunk_iters)
-                if pred_early_stop:
-                    past = it - start_iteration
-                    nxt = start_iteration + (past // pred_early_stop_freq
-                                             + 1) * pred_early_stop_freq
-                    ce = min(ce, nxt)
-                a = (it - self.loaded_iters) * k
-                b = (ce - self.loaded_iters) * k
-                chunk = jax.tree.map(lambda x: x[a:b], stacked)
-                vals = np.asarray(predict_values_stacked(chunk, bins, mb),
-                                  dtype=np.float64)              # [t, n]
-                for ti in range(b - a):
-                    _accumulate_active(out, ti % k, vals[ti], active,
-                                       pred_early_stop)
-                it = ce
-                if pred_early_stop and \
-                        (it - start_iteration) % pred_early_stop_freq == 0:
-                    active &= ~_early_stop_mask(out, k,
-                                                pred_early_stop_margin)
-                    if not active.any():
-                        break
-        return out if k > 1 else out[:, 0]
+            own_end = end_iter - self.loaded_iters
+            eng = self._predict_engine(own_end)
+            rng = ((it - self.loaded_iters) * k, own_end * k)
+            base = None
+            if out.any():        # nonzero only after a loaded-model prefix
+                base = out if k > 1 else out[:, 0]
+            if not pred_early_stop:
+                res = eng.predict(bins, mb, base=base, use_bias=False,
+                                  tree_range=rng, postprocess=_postprocess)
+                return np.asarray(res)
+            out = self._predict_early_stop(
+                eng, bins, mb, out, active, base, it, end_iter,
+                start_iteration, pred_early_stop_freq,
+                pred_early_stop_margin)
+        res = out if k > 1 else out[:, 0]
+        if _postprocess is not None:
+            # degenerate window (no own trees in range): still honor the
+            # requested device-side conversion
+            res = np.asarray(jax.device_get(_postprocess(jnp.asarray(res))))
+        return res
+
+    def _predict_early_stop(self, eng, bins, mb, out, active, base, it,
+                            end_iter, start_iteration, freq,
+                            margin) -> np.ndarray:
+        """Margin-based prediction early stop on the engine: the f64 carry
+        stays ON DEVICE across check chunks (accumulation order unchanged
+        — bit-identical to the legacy host loop), rows deactivate via a
+        device select mask, and the host sees the [n, K] scores only at
+        the freq-bounded check points. Rows beyond the streaming chunk
+        size are processed in independent row chunks (early stop is
+        per-row, so chunking is exact) — the device never holds more
+        than one chunk of the feature matrix, like the plain path."""
+        k = self.num_tree_per_iteration
+        n = bins.shape[0]
+        chunk = eng._chunk_rows(n)
+        if n > chunk:
+            outs = []
+            for a0 in range(0, n, chunk):
+                b0 = min(n, a0 + chunk)
+                outs.append(self._predict_early_stop(
+                    eng, bins[a0:b0], mb, out[a0:b0], active[a0:b0],
+                    None if base is None else base[a0:b0], it, end_iter,
+                    start_iteration, freq, margin))
+            return np.concatenate(outs, axis=0)
+        bucket = eng.bucket_rows(n)
+        pad = bucket - n
+        bins_dev = eng.prepare_bins(bins, bucket)
+        carry = eng.make_carry(base, bucket)
+
+        def upload_active(a_np):
+            return eng._upload_rows(np.pad(a_np, (0, pad)) if pad
+                                    else a_np, eng.sharded)
+
+        active_dev = upload_active(active)
+        while it < end_iter:
+            nxt = start_iteration + ((it - start_iteration) // freq
+                                     + 1) * freq
+            ce = min(end_iter, nxt)
+            a = (it - self.loaded_iters) * k
+            b = (ce - self.loaded_iters) * k
+            carry = eng.accumulate(bins_dev, mb, carry, active_dev,
+                                   tree_range=(a, b), use_bias=False)
+            it = ce
+            if (it - start_iteration) % freq == 0 and it < end_iter:
+                out = eng.fetch(carry, n).reshape(n, k)
+                active &= ~_early_stop_mask(out, k, margin)
+                if not active.any():
+                    return out
+                active_dev = upload_active(active)
+        return eng.fetch(carry, n).reshape(n, k)
+
+    def _engine_predict_ok(self) -> bool:
+        """Whether predict_raw routes the WHOLE ensemble through the
+        device engine with the conversion fused before the fetch (no
+        host-walked prefix; RF's averaged output divides on host AFTER
+        the engine sum, so its conversion cannot fuse)."""
+        return (not self.config.linear_tree
+                and self.train_set.bundles is None
+                and self.loaded_iters == 0
+                and not self.average_output
+                and len(self.trees) > 0)
 
     def predict(self, X, raw_score: bool = False,
                 num_iteration: Optional[int] = None,
@@ -2102,13 +2261,21 @@ class GBDT:
                 pred_early_stop: bool = False,
                 pred_early_stop_freq: int = 10,
                 pred_early_stop_margin: float = 10.0) -> np.ndarray:
+        if not (raw_score or self.objective is None) \
+                and not pred_early_stop and self._engine_predict_ok():
+            # conversion fused on device BEFORE the single [n, K] fetch:
+            # a converted full-ensemble predict is <= 3 dispatches
+            # (ensemble scan, jitted conversion, row-pad slice)
+            return self.predict_raw(
+                X, num_iteration, start_iteration,
+                _postprocess=self._convert_output_jit())
         raw = self.predict_raw(X, num_iteration, start_iteration,
                                pred_early_stop=pred_early_stop,
                                pred_early_stop_freq=pred_early_stop_freq,
                                pred_early_stop_margin=pred_early_stop_margin)
         if raw_score or self.objective is None:
             return raw
-        conv = np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+        conv = np.asarray(self._convert_output_jit()(jnp.asarray(raw)))
         return conv
 
     def predict_leaf(self, X, num_iteration: Optional[int] = None,
@@ -2118,7 +2285,7 @@ class GBDT:
         bundled = self.train_set.bundles is not None
         # bundled datasets traverse raw features via ModelTree (see
         # predict_raw) — don't bin the prediction matrix at all
-        bins = None if bundled else jnp.asarray(self.train_set.bin_new_data(X))
+        bins = None if bundled else self.train_set.bin_new_data(X)
         k = self.num_tree_per_iteration
         total_iters = self.loaded_iters + len(self.trees) // k
         if num_iteration is None or num_iteration <= 0:
@@ -2149,15 +2316,20 @@ class GBDT:
                     cols.append(mt.leaf_index(X))
                 it += 1
         elif it < end_iter:
-            # own trees: batched device dispatches over the stacked
-            # ensemble (like predict_raw — not one round trip per tree)
-            stacked = self._stacked()
+            # own trees: the engine's depth-bounded stacked traversal
+            # (like predict_raw — not one round trip per tree); the [t, n]
+            # leaf transfer is inherent to this API, so only the tree-range
+            # chunking bounds the host buffer
+            own_end = end_iter - self.loaded_iters
+            eng = self._predict_engine(own_end)
             n = bins.shape[0]
+            # upload the padded bin matrix ONCE; the tree-range chunks
+            # below reuse the resident device copy
+            bins_dev = eng.prepare_bins(bins, eng.bucket_rows(n))
             for a, b in _chunked_tree_ranges(
-                    it - self.loaded_iters, end_iter - self.loaded_iters,
-                    k, n, itemsize=4):
-                chunk = jax.tree.map(lambda x: x[a:b], stacked)
-                leaves = np.asarray(predict_leaves_stacked(chunk, bins, mb))
+                    it - self.loaded_iters, own_end, k, n, itemsize=4):
+                leaves = eng.leaves(bins_dev, mb, tree_range=(a, b),
+                                    n_rows=n)
                 cols.extend(list(leaves))            # [t, n] -> t columns
         return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0),
                                                             np.int32)

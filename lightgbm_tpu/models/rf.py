@@ -191,9 +191,10 @@ class RF(GBDT):
     def predict_raw(self, X, num_iteration: Optional[int] = None,
                     start_iteration: int = 0, **_kwargs) -> np.ndarray:
         """Average of tree outputs (average_output_, gbdt_prediction.cpp);
-        prediction early stop does not apply to averaged outputs."""
-        from .tree import predict_value_bins
-        bins = jnp.asarray(self.train_set.bin_new_data(X))
+        prediction early stop does not apply to averaged outputs. Summed
+        on device by the inference engine in tree order (bit-identical to
+        the former per-tree host loop), averaged on host."""
+        bins = self.train_set.bin_new_data(X)
         k = self.num_tree_per_iteration
         n = bins.shape[0]
         total_iters = len(self.trees) // k
@@ -204,9 +205,10 @@ class RF(GBDT):
         used = max(end_iter - start_iteration, 1)
         out = np.zeros((n, k), dtype=np.float64)
         mb = self.train_set.missing_bin
-        for it in range(start_iteration, end_iter):
-            for c in range(k):
-                tree = self.trees[it * k + c]
-                out[:, c] += np.asarray(predict_value_bins(tree, bins, mb))
+        if start_iteration < end_iter:
+            eng = self._predict_engine(end_iter)
+            res = eng.predict(bins, mb, use_bias=False,
+                              tree_range=(start_iteration * k, end_iter * k))
+            out = np.array(res, np.float64).reshape(n, k)
         out /= used
         return out if k > 1 else out[:, 0]

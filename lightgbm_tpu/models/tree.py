@@ -94,15 +94,12 @@ def _decide_left_bins(bin_val, threshold_bin, default_left, missing_bin,
     return jnp.where(is_cat, cat_left, num_left)
 
 
-def predict_leaf_bins(tree: TreeArrays, bins: jax.Array,
-                      missing_bin: jax.Array) -> jax.Array:
-    """Leaf index per row by traversing over the binned matrix.
-
-    Args:
-      bins: [N, F] int bins.
-      missing_bin: [F] int32, per-feature default-routed bin or -1.
-    Returns [N] int32 leaf indices.
-    """
+def _traversal_setup(tree: TreeArrays, bins: jax.Array,
+                     missing_bin: jax.Array):
+    """Shared setup of the level-by-level traversal: the 0-feature guard,
+    the step body (descend every active row one edge) and the initial
+    (cur, leaf) state. Used by both the data-dependent while_loop
+    traversal and the depth-bounded fori_loop traversal below."""
     n = bins.shape[0]
     if bins.shape[1] == 0:
         # 0-feature dataset (every feature pre-filtered as trivial): all
@@ -113,11 +110,7 @@ def predict_leaf_bins(tree: TreeArrays, bins: jax.Array,
         missing_bin = jnp.full((1,), -1, dtype=jnp.int32)
     rows = jnp.arange(n, dtype=jnp.int32)
 
-    def cond(state):
-        cur, _ = state
-        return jnp.any(cur >= 0)
-
-    def body(state):
+    def step(state):
         cur, leaf = state
         active = cur >= 0
         node = jnp.maximum(cur, 0)
@@ -132,11 +125,45 @@ def predict_leaf_bins(tree: TreeArrays, bins: jax.Array,
         new_leaf = jnp.where(active & (nxt < 0), ~nxt, leaf)
         return nxt, new_leaf
 
-    init = (jnp.zeros((n,), dtype=jnp.int32),
-            jnp.zeros((n,), dtype=jnp.int32))
     # single-leaf tree: no nodes to traverse
     init_cur = jnp.where(tree.num_leaves <= 1, -1, 0) * jnp.ones((n,), jnp.int32)
-    _, leaf = jax.lax.while_loop(cond, body, (init_cur, init[1]))
+    return step, (init_cur, jnp.zeros((n,), dtype=jnp.int32))
+
+
+def predict_leaf_bins(tree: TreeArrays, bins: jax.Array,
+                      missing_bin: jax.Array) -> jax.Array:
+    """Leaf index per row by traversing over the binned matrix.
+
+    Args:
+      bins: [N, F] int bins.
+      missing_bin: [F] int32, per-feature default-routed bin or -1.
+    Returns [N] int32 leaf indices.
+    """
+    step, init = _traversal_setup(tree, bins, missing_bin)
+
+    def cond(state):
+        return jnp.any(state[0] >= 0)
+
+    _, leaf = jax.lax.while_loop(cond, lambda s: step(s), init)
+    return leaf
+
+
+def predict_leaf_bins_depth(tree: TreeArrays, bins: jax.Array,
+                            missing_bin: jax.Array, depth: int) -> jax.Array:
+    """Depth-bounded traversal: a ``fori_loop`` with a STATIC trip count
+    instead of the data-dependent ``while_loop`` above. ``depth`` must be
+    >= the deepest leaf's edge count in ``tree`` — rows whose leaf is
+    reached earlier mask out (cur < 0) and the remaining steps are
+    no-ops, so the leaf indices are IDENTICAL to predict_leaf_bins.
+
+    The point: inside a stacked-ensemble scan the while_loop stalls every
+    batch on its slowest row AND blocks XLA from pipelining/fusing across
+    trees (a data-dependent trip count is a hard scheduling barrier); a
+    fixed trip count turns the whole ensemble traversal into a statically
+    schedulable loop nest (the batched analog of the reference's
+    unconditional per-node descent, gbdt_prediction.cpp:13-53)."""
+    step, init = _traversal_setup(tree, bins, missing_bin)
+    _, leaf = jax.lax.fori_loop(0, depth, lambda _, s: step(s), init)
     return leaf
 
 

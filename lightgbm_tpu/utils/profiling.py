@@ -268,6 +268,21 @@ def dispatch_delta(before: Dict[str, int],
     return {k: after[k] - before.get(k, 0) for k in after}
 
 
+@contextmanager
+def dispatch_scope() -> Iterator[Dict[str, int]]:
+    """Scoped dispatch/transfer deltas: ``with dispatch_scope() as d:
+    ...`` — after the block ``d`` holds the counter deltas for the work
+    dispatched inside it (all zero unless ``install_dispatch_hook`` is
+    live). The one-liner bench.py and the predict-engine regression
+    tests both wrap their measured region in."""
+    before = dispatch_stats()
+    d: Dict[str, int] = {}
+    try:
+        yield d
+    finally:
+        d.update(dispatch_delta(before))
+
+
 def table() -> str:
     """Aggregated per-scope wall-time table (reference: the USE_TIMETAG
     summary printed by ~Timer, common.h:970-990), followed by the named

@@ -36,14 +36,33 @@ def rng():
     return np.random.RandomState(42)
 
 
+REFERENCE_EXAMPLES = "/root/reference/examples"
+REFERENCE_DATA_REASON = ("reference example data unavailable "
+                         f"({REFERENCE_EXAMPLES} is not in this image)")
+
+
+def reference_data_available() -> bool:
+    return os.path.isdir(REFERENCE_EXAMPLES)
+
+
+def require_reference_data() -> None:
+    """Skip (not error) when the reference's example files are absent —
+    a missing /root/reference is an environment gap, and the ERROR noise
+    it used to produce masked real regressions in the tier-1 dot line."""
+    if not reference_data_available():
+        pytest.skip(REFERENCE_DATA_REASON)
+
+
 def _example_path(name):
-    return os.path.join("/root/reference/examples", name)
+    return os.path.join(REFERENCE_EXAMPLES, name)
 
 
 @pytest.fixture(scope="session")
 def binary_example():
     """The reference's binary_classification example data
-    (examples/binary_classification/binary.{train,test}; label in col 0)."""
+    (examples/binary_classification/binary.{train,test}; label in col 0).
+    Skips cleanly when the reference checkout is absent."""
+    require_reference_data()
     train = np.loadtxt(_example_path("binary_classification/binary.train"))
     test = np.loadtxt(_example_path("binary_classification/binary.test"))
     return (train[:, 1:], train[:, 0], test[:, 1:], test[:, 0])

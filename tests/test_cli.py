@@ -15,11 +15,22 @@ import lightgbm_tpu as lgb
 from lightgbm_tpu.cli import main as cli_main
 from lightgbm_tpu.native import native_available, parse_text_file
 
+from conftest import REFERENCE_DATA_REASON, reference_data_available
+
 EXAMPLES = "/root/reference/examples"
+
+# tests binding to the reference's example files skip cleanly when the
+# checkout is absent (previously: 2 OSError FAILURES in the native-parser
+# tests + a fixture ERROR per workdir consumer — environment noise, not
+# regressions). The csv/qid tests below are self-contained and still run.
+needs_reference_data = pytest.mark.skipif(
+    not reference_data_available(), reason=REFERENCE_DATA_REASON)
 
 
 @pytest.fixture(scope="module")
 def workdir(tmp_path_factory):
+    if not reference_data_available():
+        pytest.skip(REFERENCE_DATA_REASON)
     d = tmp_path_factory.mktemp("cli")
     for f in ("binary.train", "binary.test"):
         src = os.path.join(EXAMPLES, "binary_classification", f)
@@ -31,6 +42,7 @@ def workdir(tmp_path_factory):
     os.chdir(orig)
 
 
+@needs_reference_data
 def test_native_parser_matches_numpy():
     path = os.path.join(EXAMPLES, "binary_classification", "binary.train")
     mat, fmt = parse_text_file(path)
@@ -39,6 +51,7 @@ def test_native_parser_matches_numpy():
     np.testing.assert_allclose(mat, ref)
 
 
+@needs_reference_data
 def test_native_parser_libsvm():
     path = os.path.join(EXAMPLES, "lambdarank", "rank.train")
     mat, fmt = parse_text_file(path)
