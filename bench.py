@@ -42,6 +42,21 @@ FULL_ROWS = 10_500_000
 PEAK_F32_FLOPS = 98e12
 
 
+def _health_json():
+    """Supervision/health telemetry for the result JSON (restart count,
+    heartbeat table when supervised, health gauges)."""
+    try:
+        from lightgbm_tpu import distributed
+        from lightgbm_tpu.utils import profiling
+        out = distributed.health_snapshot()
+        g = profiling.gauges()
+        if g:
+            out["gauges"] = {k: round(v, 3) for k, v in g.items()}
+        return out
+    except Exception:
+        return None
+
+
 def run_at_scale(rows, args, hist_method="auto", hist_compaction=True):
     import numpy as np
     import jax
@@ -325,6 +340,12 @@ def main():
         "rows_streamed_per_tree": round(rows_per_tree, 1)
         if rows_per_tree is not None else None,
         "phases": {k: round(v, 3) for k, v in phases.items()},
+        # training-supervision health (distributed.health_snapshot +
+        # profiling gauges): supervisor restart count, last completed
+        # iteration, and — in supervised multi-process runs — the
+        # per-rank heartbeat ages/iterations. Single-process benches
+        # record restart_count 0 and no heartbeat table.
+        "health": _health_json(),
     }
     # insurance: print the headline line NOW — a later probe that wedges
     # the tunnel (observed 2026-07-31) must not cost the round its number.

@@ -8,7 +8,7 @@ training over device meshes, and a Python API mirroring the reference's
 python-package surface (Dataset/Booster/train/cv/sklearn wrappers).
 """
 
-from . import checkpoint, distributed
+from . import checkpoint, distributed, supervisor
 from .basic import Dataset
 from .booster import Booster
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
@@ -18,6 +18,7 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
 # lightgbm_tpu.checkpoint submodule (CheckpointManager and friends)
 from .callback import checkpoint as checkpoint_callback
 from .config import Config
+from .distributed import DistributedTimeoutError
 from .engine import CVBooster, cv, train
 from .utils.log import register_logger
 
@@ -27,7 +28,7 @@ __all__ = [
     "Dataset", "Booster", "Config", "train", "cv", "CVBooster",
     "register_logger", "early_stopping", "print_evaluation", "log_evaluation",
     "record_evaluation", "reset_parameter", "EarlyStopException",
-    "checkpoint_callback",
+    "checkpoint_callback", "DistributedTimeoutError",
 ]
 
 

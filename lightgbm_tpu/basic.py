@@ -669,10 +669,29 @@ class Dataset:
         owner_orig = np.zeros((g, b), np.int32)
         thr_fwd = np.tile(np.arange(b, dtype=np.int32), (g, 1))
         thr_rev = np.tile(np.arange(b, dtype=np.int32), (g, 1))
+        # tie-break preference tables (higher wins among equal-gain
+        # candidates), ordered by the candidate's ORIGINAL feature index
+        # first so within-bundle and cross-column ties resolve exactly as
+        # the unbundled scan's feature-major order would (ops/split.py
+        # BundleMeta docstring; without these a within-bundle tie goes to
+        # the highest-offset member — the opposite of the unbundled run)
+        u = int(self.num_total_features)
+        pref_fwd = np.zeros((g, b), np.int32)
+        pref_rev = np.zeros((g, b), np.int32)
+
+        def _owner_base(j):
+            return (u - 1 - j) * 4 * b
+
         for gi, bd in enumerate(bundles):
             if len(bd.members) == 1:
+                j = int(used[bd.members[0]])
                 seg_hi[gi, :] = nb[gi] - 1
-                owner_orig[gi, :] = int(used[bd.members[0]])
+                owner_orig[gi, :] = j
+                # plain column: the standard rev-first / high-threshold /
+                # fwd low-threshold order, keyed by the original feature
+                t = np.arange(b, dtype=np.int32)
+                pref_rev[gi, :] = _owner_base(j) + 2 * b + t
+                pref_fwd[gi, :] = _owner_base(j) + (b - 1) - t
                 continue
             is_bundle[gi] = True
             # per-bin candidate masks reproducing each member's UNBUNDLED
@@ -703,19 +722,34 @@ class Dataset:
                     rev_ok[gi, dslice] = ok
                     thr_fwd[gi, dslice] = t_orig
                     thr_rev[gi, dslice] = t_orig
+                    # unbundled mode-A scan order: rev first (high
+                    # threshold wins), fwd on strictly-greater only
+                    pref_rev[gi, dslice] = _owner_base(j) + 2 * b + t_orig
+                    pref_fwd[gi, dslice] = _owner_base(j) + (b - 1) - t_orig
                 else:
                     fwd_ok[gi, dslice] = r < z
                     rev_ok[gi, dslice] = (r >= z - 1) & (r <= nbm - 3)
                     thr_fwd[gi, dslice] = r
                     thr_rev[gi, dslice] = r + 1
+                    # the member's UNBUNDLED scan is a single REVERSE pass
+                    # (missing_type none): every candidate — including the
+                    # ones the bundle must evaluate as forward-direction —
+                    # competes with the rev preference of its original
+                    # threshold, so ties resolve to the highest threshold
+                    # like the plain column's scan
+                    pref_fwd[gi, dslice] = _owner_base(j) + 2 * b + r
+                    pref_rev[gi, dslice] = _owner_base(j) + 2 * b + (r + 1)
                     if z == 0:                  # phantom: left = z mass only
                         rev_ok[gi, off] = True
                         thr_rev[gi, off] = 0
+                        pref_rev[gi, off] = _owner_base(j) + 2 * b
         self._bundle_meta = BundleMeta(seg_lo=jnp.asarray(seg_lo),
                                        seg_hi=jnp.asarray(seg_hi),
                                        is_bundle=jnp.asarray(is_bundle),
                                        fwd_ok=jnp.asarray(fwd_ok),
-                                       rev_ok=jnp.asarray(rev_ok))
+                                       rev_ok=jnp.asarray(rev_ok),
+                                       pref_fwd=jnp.asarray(pref_fwd),
+                                       pref_rev=jnp.asarray(pref_rev))
         self._owner_orig = owner_orig
         self._thr_fwd = thr_fwd
         self._thr_rev = thr_rev

@@ -63,8 +63,9 @@ _NON_TRAINING_PARAMS = frozenset({
     "convert_model", "convert_model_language", "verbosity", "snapshot_freq",
     "metric_freq", "num_threads", "machine_list_filename",
     "checkpoint_path", "checkpoint_keep", "check_numerics",
-    "fault_kill_at_iter", "fault_nan_grad_at_iter",
-    "fault_corrupt_checkpoint",
+    "heartbeat_interval", "collective_deadline", "max_restarts",
+    "fault_kill_at_iter", "fault_hang_at_iter", "fault_kill_in_ckpt_write",
+    "fault_nan_grad_at_iter", "fault_corrupt_checkpoint",
 })
 
 
@@ -155,18 +156,39 @@ class CheckpointManager:
         return path
 
     def _write(self, booster, iteration: int) -> str:
+        """Stage the whole checkpoint in ``ckpt_N.tmp`` and publish it with
+        one directory rename. A writer killed at ANY point leaves either no
+        ``ckpt_N`` at all (a stale ``.tmp`` the name filter ignores and the
+        next write cleans) or a complete one — and within the stage the
+        manifest still lands last, so even a non-staged legacy directory
+        can only be complete-or-rejected."""
         name = f"ckpt_{iteration:08d}"
         path = os.path.join(self.directory, name)
-        os.makedirs(path, exist_ok=True)
+        stage = path + ".tmp"
+        os.makedirs(self.directory, exist_ok=True)
+        self._clean_stale_tmp()
+        if os.path.isdir(path):
+            if self._quick_valid(path):
+                # a resumed incarnation re-reaches an already-checkpointed
+                # iteration: resume is bit-identical, so the existing
+                # VALID checkpoint already holds these bytes — keeping it
+                # (instead of delete-then-republish) means a kill can
+                # never destroy a published valid checkpoint
+                self._prune()
+                return path
+            shutil.rmtree(path, ignore_errors=True)
+        os.makedirs(stage, exist_ok=True)
         model_bytes = booster.model_to_string(num_iteration=-1).encode()
         state_bytes = pickle.dumps(capture_state(booster), protocol=4)
-        atomic_write_bytes(os.path.join(path, MODEL_NAME), model_bytes)
-        atomic_write_bytes(os.path.join(path, STATE_NAME), state_bytes)
+        atomic_write_bytes(os.path.join(stage, MODEL_NAME), model_bytes)
+        atomic_write_bytes(os.path.join(stage, STATE_NAME), state_bytes)
+        faults.maybe_kill_in_ckpt_write(self._fault_plan, iteration)
         if self._dataset_fp is None:
             self._dataset_fp = dataset_fingerprint(
                 booster._boosting.train_set)
         phash = getattr(booster, "_initial_params_hash", None) \
             or params_hash(booster.config)
+        from . import distributed
         manifest = {
             "format": MANIFEST_FORMAT,
             "iteration": int(iteration),
@@ -178,20 +200,74 @@ class CheckpointManager:
                 STATE_NAME: {"bytes": len(state_bytes),
                              "sha256": hashlib.sha256(state_bytes).hexdigest()},
             },
+            # supervision telemetry: which incarnation wrote this, and the
+            # gang's liveness view at write time (postmortem breadcrumbs)
+            "health": distributed.health_snapshot(),
         }
-        # the manifest lands LAST: its presence marks the checkpoint
-        # complete, so a kill between the writes above leaves a directory
-        # that load_latest_valid skips
-        atomic_write_text(os.path.join(path, MANIFEST_NAME),
+        # the manifest lands LAST within the stage; the rename publishes
+        # the complete checkpoint atomically (the target cannot exist:
+        # valid ones short-circuited above, invalid ones were removed)
+        atomic_write_text(os.path.join(stage, MANIFEST_NAME),
                           json.dumps(manifest, indent=1, sort_keys=True))
+        os.replace(stage, path)
         faults.maybe_corrupt_checkpoint(self._fault_plan,
                                         os.path.join(path, MODEL_NAME))
         self._prune()
         return path
 
+    def _clean_stale_tmp(self) -> None:
+        """Remove ``ckpt_*.tmp`` staging directories a killed writer left
+        behind (they never match ``_CKPT_RE`` so readers already ignore
+        them; this reclaims the disk)."""
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return
+        for entry in entries:
+            if entry.startswith("ckpt_") and entry.endswith(".tmp"):
+                stale = os.path.join(self.directory, entry)
+                log.warning(f"removing stale checkpoint staging dir "
+                            f"{entry} (writer was killed mid-write)")
+                shutil.rmtree(stale, ignore_errors=True)
+
+    def _quick_valid(self, path: str) -> bool:
+        """Cheap structural validation for PRUNING decisions: manifest
+        parses and every listed file exists with the recorded byte length.
+        (Checksums are deliberately skipped — pruning runs on every save;
+        ``validate`` does the full sha256 pass on the read side.)"""
+        mpath = os.path.join(path, MANIFEST_NAME)
+        try:
+            with open(mpath) as fh:
+                manifest = json.load(fh)
+            if manifest.get("format") != MANIFEST_FORMAT:
+                return False
+            files = manifest.get("files", {})
+            if not files:
+                return False
+            for fname, meta in files.items():
+                if os.path.getsize(os.path.join(path, fname)) \
+                        != int(meta["bytes"]):
+                    return False
+        except (OSError, ValueError, KeyError, TypeError):
+            return False
+        return True
+
     def _prune(self) -> None:
-        ckpts = self.checkpoints()
-        for _it, path in ckpts[:-self.keep]:
+        """Retention by VALIDITY, not by name: keep the newest ``keep``
+        structurally valid checkpoints; checkpoints that fail validation
+        are deleted (they can never be resumed from) and never count
+        toward ``keep`` — so a run of damaged newer checkpoints can't
+        evict the newest checkpoint that actually works."""
+        valid, invalid = [], []
+        for it, path in self.checkpoints():
+            (valid if self._quick_valid(path) else invalid).append(
+                (it, path))
+        for it, path in valid[:-self.keep]:
+            shutil.rmtree(path, ignore_errors=True)
+        for it, path in invalid:
+            log.warning(f"pruning invalid checkpoint "
+                        f"{os.path.basename(path)} (failed structural "
+                        f"validation; it could never be resumed from)")
             shutil.rmtree(path, ignore_errors=True)
 
     # -------------------------------------------------------------- read

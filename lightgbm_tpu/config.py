@@ -232,10 +232,30 @@ class Config:
     # newest is truncated/corrupt)
     checkpoint_keep: int = 2
 
+    # Distributed training supervision (see lightgbm_tpu/supervisor.py)
+    # seconds between liveness heartbeats each rank sends to rank 0 over
+    # the supervisor's TCP side-channel (<= 0 disables; only active in
+    # multi-process runs with a heartbeat address configured)
+    heartbeat_interval: float = 5.0
+    # seconds one boosting step (or cross-process barrier) may take before
+    # the watchdog declares the collective stalled and raises a
+    # DistributedTimeoutError naming the suspect rank(s) and the last
+    # completed iteration (0 disables the watchdog)
+    collective_deadline: float = 0.0
+    # how many times the gang supervisor relaunches a failed gang from the
+    # latest valid checkpoint before giving up
+    max_restarts: int = 2
+
     # Fault injection (testing)
     # hard-exit (like SIGKILL) at the start of this 0-based iteration;
     # see lightgbm_tpu/utils/faults.py
     fault_kill_at_iter: int = -1
+    # sleep forever (interruptibly) at the start of this 0-based iteration
+    # — the hung-rank shape the collective_deadline watchdog must catch
+    fault_hang_at_iter: int = -1
+    # hard-exit in the middle of the checkpoint write for this 0-based
+    # iteration (after the payload files, before the manifest)
+    fault_kill_in_ckpt_write: int = -1
     # overwrite leading gradient values with NaN at this 0-based iteration
     fault_nan_grad_at_iter: int = -1
     # flip bytes in each checkpoint's model text right after it is written

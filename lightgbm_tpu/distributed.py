@@ -30,13 +30,21 @@ JAX's cluster autodetection fills coordinator/rank from the environment.
 
 from __future__ import annotations
 
+import json
 import os
 import socket
-from typing import Optional
+import threading
+import time
+from typing import Dict, Optional
 
 from .utils import log
 
 _initialized = False
+
+# exit code a supervised rank uses when its collective watchdog fires —
+# distinct from the fault harness's 137 kill so the supervisor can tell
+# "rank died" from "rank declared the gang stalled"
+WATCHDOG_EXIT_CODE = 97
 
 
 def is_initialized() -> bool:
@@ -254,15 +262,618 @@ def _initialize_with_backoff(kwargs: dict, retries: int, backoff: float,
             delay = min(max(delay, 0.1) * 2, 30.0)
 
 
-def barrier(name: str = "barrier") -> None:
+def barrier(name: str = "barrier", timeout: Optional[float] = None) -> None:
     """Cross-process synchronization point (no-op single-process). Used by
     the checkpoint writer so no rank races past a checkpoint another rank
-    may later resume from."""
+    may later resume from.
+
+    Prefers the distributed COORDINATION-SERVICE barrier (pure gRPC — no
+    XLA computation, so it works on every backend and takes a hard
+    deadline, the analog of the reference's socket ``time_out``,
+    linkers_socket.cpp TimeOut) over ``sync_global_devices`` (a
+    device collective). With a ``collective_deadline`` watchdog armed, the
+    barrier inherits its deadline: a peer that died or hung before
+    reaching the barrier surfaces as a DistributedTimeoutError (or a
+    supervised watchdog exit) naming the suspects instead of an
+    indefinite wait."""
     import jax
     if jax.process_count() <= 1:
         return
-    from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    wd = _active_health.watchdog if _active_health is not None else None
+    if timeout is None and wd is not None:
+        timeout = wd.deadline
+    client = None
+    try:
+        from jax._src import distributed as jax_dist
+        client = jax_dist.global_state.client
+    except Exception:
+        pass
+    with watchdog_phase(f"barrier:{name}"):
+        if client is not None:
+            try:
+                client.wait_at_barrier(
+                    f"lgbm_tpu_{name}",
+                    int((timeout or 3600.0) * 1000))
+                return
+            except DistributedTimeoutError:
+                raise
+            except Exception as e:
+                # the coordination client's error type varies by jax
+                # version: classify timeouts by message
+                msg = str(e)
+                if "DEADLINE_EXCEEDED" in msg or "imed out" in msg \
+                        or "BarrierTimedOut" in msg:
+                    _barrier_timed_out(name, wd, e)
+                raise
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def _barrier_timed_out(name: str, wd, cause) -> None:
+    """A deadlined barrier expired: some peer never arrived. Route through
+    the watchdog's diagnosis when one is armed (supervised ranks exit for
+    the gang supervisor); otherwise raise a diagnosable error directly."""
+    global _last_diagnosis
+    snap = dict(_progress.snapshot(), phase=f"barrier:{name}")
+    if wd is not None:
+        if wd.supervised:
+            wd._fire(snap)            # writes diagnosis, then os._exit
+        _last_diagnosis = wd._diagnose(snap)
+    else:
+        _last_diagnosis = {"rank": 0, "iteration": snap["iter"],
+                           "phase": snap["phase"], "suspects": None}
+    raise DistributedTimeoutError() from cause
+
+
+# ===================================================== training supervision
+# Heartbeat + collective-deadline watchdog: the detection half of the gang
+# supervisor (lightgbm_tpu/supervisor.py holds the restart half). The
+# reference survives a dead worker through per-socket recv timeouts
+# (linkers_socket.cpp TimeOut on every Recv); jax collectives have no such
+# deadline — a killed or hung rank stalls every shard_map psum forever. The
+# watchdog restores the reference's property: a bounded wait, then a
+# DIAGNOSABLE error naming the suspect rank(s) and the last completed
+# iteration.
+#
+#   - Every rank runs a heartbeat thread that reports
+#     (rank, last-completed iteration, current in-step iteration) to rank 0
+#     over a lightweight TCP side-channel (newline-JSON request/response;
+#     the address comes from LGBM_TPU_HEARTBEAT_ADDR, set by the
+#     supervisor, or an explicit start_health call). Rank 0's reply carries
+#     the aggregated table, so EVERY rank can name suspects, not just 0.
+#   - The watchdog thread checks the current phase (boosting step or
+#     cross-process barrier) against ``collective_deadline``. On expiry it
+#     writes a JSON diagnosis (LGBM_TPU_DIAG_DIR), then either hard-exits
+#     with WATCHDOG_EXIT_CODE (supervised mode — the supervisor tears down
+#     the gang and relaunches from the latest checkpoint) or raises
+#     DistributedTimeoutError in the main thread.
+
+_SUPERVISED_ENV = "LGBM_TPU_SUPERVISED"
+_HEARTBEAT_ADDR_ENV = "LGBM_TPU_HEARTBEAT_ADDR"
+_DIAG_DIR_ENV = "LGBM_TPU_DIAG_DIR"
+_RESTART_COUNT_ENV = "LGBM_TPU_RESTART_COUNT"
+
+_last_diagnosis: Optional[dict] = None
+
+
+class DistributedTimeoutError(Exception):
+    """A collective (boosting step or barrier) exceeded the configured
+    ``collective_deadline``. Carries the diagnosing rank, the last
+    completed iteration, and the suspect rank(s) the heartbeat table
+    implicates. Constructed argument-free by the watchdog's asynchronous
+    raise, in which case the message comes from the last diagnosis."""
+
+    def __init__(self, *args, rank=None, iteration=None, suspects=None,
+                 phase=None):
+        diag = _last_diagnosis or {}
+        self.rank = rank if rank is not None else diag.get("rank")
+        self.iteration = iteration if iteration is not None \
+            else diag.get("iteration")
+        self.suspects = suspects if suspects is not None \
+            else diag.get("suspects")
+        self.phase = phase if phase is not None else diag.get("phase")
+        if not args:
+            args = (format_timeout_message(self.rank, self.iteration,
+                                           self.suspects, self.phase,
+                                           diag.get("deadline")),)
+        super().__init__(*args)
+
+
+def format_timeout_message(rank, iteration, suspects, phase,
+                           deadline) -> str:
+    if suspects:
+        sus = "rank(s) " + ", ".join(str(s) for s in suspects)
+    elif suspects is not None:
+        sus = "none identified (heartbeat table shows all ranks current)"
+    else:
+        sus = "unknown rank (no heartbeat table)"
+    return (f"collective deadline"
+            + (f" ({deadline:g}s)" if deadline else "")
+            + f" exceeded on rank {rank} in {phase or 'step'}: "
+            f"last completed iteration {iteration}; suspect {sus}. "
+            f"The gang is stalled — restart it from the latest checkpoint "
+            f"(lightgbm_tpu.supervisor does this automatically).")
+
+
+class _Progress:
+    """Per-process training progress the heartbeat reports and the
+    watchdog judges against: a stack of active phases (step / barrier)
+    plus the last COMPLETED boosting iteration."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.last_iter = -1            # last completed boosting iteration
+        self.step_iter = -1            # iteration currently inside a step
+        self.steps_done = 0            # steps completed IN THIS PROCESS —
+        #   the compile-exemption clock: last_iter is the GLOBAL iteration
+        #   and starts at k on a resumed incarnation, which would strip
+        #   the fresh process's first-step/first-eval compile exemptions
+        self.phases = []               # [(label, start_monotonic)]
+        self.last_transition = None    # monotonic time of last begin/end
+
+    def reset(self) -> None:
+        """Fresh training run: clear completed-iteration history so the
+        first-step compile exemption applies again."""
+        with self.lock:
+            self.last_iter = -1
+            self.step_iter = -1
+            self.steps_done = 0
+            self.phases = []
+            self.last_transition = None
+
+    def begin(self, label: str, iteration: Optional[int] = None) -> None:
+        with self.lock:
+            now = time.monotonic()
+            self.phases.append((label, now))
+            self.last_transition = now
+            if iteration is not None:
+                self.step_iter = iteration
+
+    def end(self, iteration: Optional[int] = None) -> None:
+        with self.lock:
+            if self.phases:
+                self.phases.pop()
+            self.last_transition = time.monotonic()
+            if iteration is not None:
+                if iteration > self.last_iter:
+                    self.steps_done += 1
+                self.last_iter = iteration
+                if not self.phases:
+                    self.step_iter = -1
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            now = time.monotonic()
+            top = self.phases[-1] if self.phases else None
+            return {"iter": self.last_iter, "step": self.step_iter,
+                    "steps_done": self.steps_done,
+                    "phase": top[0] if top else None,
+                    "phase_elapsed": (now - top[1]) if top else 0.0,
+                    "idle_elapsed": (now - self.last_transition)
+                    if self.last_transition is not None else 0.0}
+
+
+_progress = _Progress()
+
+
+def notify_step_begin(iteration: int, label: str = "step") -> None:
+    """Mark entry into boosting iteration ``iteration`` (the watchdog's
+    clock starts; the heartbeat starts reporting it as in-flight)."""
+    _progress.begin(f"{label}:{iteration}", iteration)
+
+
+def notify_step_end(iteration: int) -> None:
+    """Mark completion of boosting iteration ``iteration``."""
+    _progress.end(iteration)
+
+
+class watchdog_phase:
+    """Context manager marking a non-step collective phase (barriers,
+    allgathers) so the watchdog times it too. Reentrant; no-op overhead
+    when no watchdog is armed (the progress stack is a few list ops)."""
+
+    def __init__(self, label: str):
+        self.label = label
+
+    def __enter__(self):
+        _progress.begin(self.label)
+        return self
+
+    def __exit__(self, *exc):
+        _progress.end()
+        return False
+
+
+class HeartbeatMonitor:
+    """Rank liveness over a TCP side-channel.
+
+    Rank 0 runs the aggregation server; every rank (0 included) feeds its
+    progress in every ``interval`` seconds and receives the aggregated
+    table back. The table maps rank -> {iter, step, age} where ``age`` is
+    seconds since that rank's last report reached rank 0."""
+
+    def __init__(self, rank: int, nproc: int, addr: str,
+                 interval: float = 5.0):
+        self.rank = int(rank)
+        self.nproc = int(nproc)
+        host, _, port = addr.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.interval = max(0.2, float(interval))
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._server_table: Dict[int, dict] = {}   # rank0: rank -> report
+        self._table: Dict[int, dict] = {}          # last aggregated view
+        self._threads = []
+        self._server_sock = None
+
+    # ------------------------------------------------------------- server
+    def _serve(self) -> None:
+        srv = self._server_sock
+        srv.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True, name="lgbm-hb-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _handle(self, conn) -> None:
+        conn.settimeout(max(4 * self.interval, 10.0))
+        try:
+            fh = conn.makefile("rw", encoding="utf-8", newline="\n")
+            for line in fh:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                now = time.monotonic()
+                with self._lock:
+                    self._server_table[int(msg.get("rank", -1))] = {
+                        "iter": msg.get("iter", -1),
+                        "step": msg.get("step", -1),
+                        "recv": now}
+                    reply = json.dumps({"table": self._aggregated()})
+                fh.write(reply + "\n")
+                fh.flush()
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _aggregated(self) -> dict:
+        # caller HOLDS self._lock (mutates and iterates the table)
+        mine = _progress.snapshot()
+        now = time.monotonic()
+        self._server_table[self.rank] = {"iter": mine["iter"],
+                                         "step": mine["step"], "recv": now}
+        out = {str(r): {"iter": e["iter"], "step": e["step"],
+                        "age": round(now - e["recv"], 3)}
+               for r, e in self._server_table.items()}
+        # mirror into the health gauges (bench.py JSON / postmortems):
+        # heartbeat age + last completed iteration per rank
+        from .utils import profiling
+        for r, e in out.items():
+            profiling.set_gauge(f"heartbeat_age_rank{r}", e["age"])
+            profiling.set_gauge(f"last_iter_rank{r}", e["iter"])
+        return out
+
+    # ------------------------------------------------------------- client
+    def _beat(self) -> None:
+        fh = None
+        while not self._stop.is_set():
+            if fh is None:
+                try:
+                    conn = socket.create_connection(self.addr, timeout=5.0)
+                    conn.settimeout(max(4 * self.interval, 10.0))
+                    fh = conn.makefile("rw", encoding="utf-8", newline="\n")
+                except OSError:
+                    self._stop.wait(self.interval)
+                    continue
+            mine = _progress.snapshot()
+            try:
+                fh.write(json.dumps({"rank": self.rank,
+                                     "iter": mine["iter"],
+                                     "step": mine["step"],
+                                     "t": time.time()}) + "\n")
+                fh.flush()
+                reply = json.loads(fh.readline())
+                with self._lock:
+                    self._table = {int(r): dict(e) for r, e in
+                                   reply.get("table", {}).items()}
+            except (OSError, ValueError):
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+                fh = None
+            self._stop.wait(self.interval)
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+    # -------------------------------------------------------------- api
+    def start(self) -> "HeartbeatMonitor":
+        if self.rank == 0:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(self.addr)
+            srv.listen(max(self.nproc, 8))
+            self._server_sock = srv
+            t = threading.Thread(target=self._serve, daemon=True,
+                                 name="lgbm-hb-server")
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._beat, daemon=True,
+                             name="lgbm-hb-client")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+
+    def table(self) -> Dict[int, dict]:
+        """Latest aggregated liveness table (rank -> iter/step/age)."""
+        if self.rank == 0:
+            with self._lock:
+                return {int(r): dict(e)
+                        for r, e in self._aggregated().items()}
+        with self._lock:
+            return {r: dict(e) for r, e in self._table.items()}
+
+    def suspects(self, my_step: int, my_iter: int = -1) -> Optional[list]:
+        """Ranks implicated in a stall: dead (stale heartbeat), missing
+        (never reported), or lagging (their reported progress — completed
+        iteration or in-flight step — is behind this rank's: the hung-rank
+        signature, where the process is alive and its heartbeat fresh but
+        it never dispatched the step everyone else is blocked in).
+        Returns None (unknown) when the table is empty — an unreplied
+        heartbeat must not masquerade as confident evidence implicating
+        every rank including the caller."""
+        table = self.table()
+        if not table:
+            return None
+        out = set()
+        stale_after = max(3 * self.interval, 5.0)
+        my_progress = max(my_step, my_iter)
+        for r in range(self.nproc):
+            e = table.get(r)
+            if e is None:
+                out.add(r)
+                continue
+            progress = max(e.get("step", -1), e.get("iter", -1))
+            if e.get("age", 0.0) > stale_after:
+                out.add(r)
+            elif my_progress >= 0 and progress < my_progress \
+                    and r != self.rank:
+                out.add(r)
+        return sorted(out)
+
+
+class CollectiveWatchdog:
+    """Deadline monitor over the progress stack. ``deadline`` seconds after
+    a phase (boosting step / barrier) begins without ending, the watchdog
+    diagnoses the stall and terminates it — supervised ranks exit with
+    WATCHDOG_EXIT_CODE for the gang supervisor to reap; unsupervised runs
+    get a DistributedTimeoutError raised in the main thread."""
+
+    def __init__(self, deadline: float, rank: int = 0,
+                 heartbeat: Optional[HeartbeatMonitor] = None,
+                 supervised: Optional[bool] = None,
+                 diag_dir: Optional[str] = None):
+        self.deadline = float(deadline)
+        self.rank = int(rank)
+        self.heartbeat = heartbeat
+        self.supervised = (os.environ.get(_SUPERVISED_ENV) == "1"
+                           if supervised is None else bool(supervised))
+        self.diag_dir = diag_dir if diag_dir is not None \
+            else os.environ.get(_DIAG_DIR_ENV)
+        self._stop = threading.Event()
+        self._fired = threading.Event()
+        self._main_thread = threading.main_thread()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "CollectiveWatchdog":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="lgbm-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        tick = min(0.25, self.deadline / 4)
+        while not self._stop.wait(tick):
+            snap = _progress.snapshot()
+            if snap["phase"] is None:
+                # between steps: the training loop itself has gone quiet —
+                # the HUNG rank's own signature (its peers see a stalled
+                # step; it sees nothing moving). Judged only after TWO
+                # steps completed IN THIS PROCESS: the first between-steps
+                # interval holds the initial valid-set eval's jit compile,
+                # which — like the first step's own compile — says nothing
+                # about a stalled peer and must not kill a healthy gang
+                # (in-process count, so resumed/relaunched incarnations
+                # keep the exemption for THEIR first interval too).
+                if snap["steps_done"] >= 2 \
+                        and snap["idle_elapsed"] > self.deadline:
+                    snap = dict(snap, phase="between-steps (host-side)")
+                    self._fire(snap)
+                    return
+                continue
+            # compile warm-up exemption: the FIRST boosting step THIS
+            # PROCESS runs includes jit compilation, whose wall time has
+            # nothing to do with a stalled collective — step phases are
+            # judged only once one in-process step completed. Barriers and
+            # other explicitly marked collective phases (no compile
+            # inside) are always judged; a gang member dying before anyone
+            # finishes its first step is caught by the supervisor's
+            # incarnation timeout.
+            if snap["phase"].startswith("step:") and snap["steps_done"] < 1:
+                continue
+            if snap["phase_elapsed"] > self.deadline:
+                self._fire(snap)
+                return
+
+    def _diagnose(self, snap: dict) -> dict:
+        suspects = None
+        table = None
+        if self.heartbeat is not None:
+            try:
+                suspects = self.heartbeat.suspects(snap["step"],
+                                                   snap["iter"])
+                table = {str(r): e for r, e in
+                         self.heartbeat.table().items()}
+            except Exception:
+                pass
+        return {"rank": self.rank, "iteration": snap["iter"],
+                "stalled_iteration": snap["step"], "phase": snap["phase"],
+                "elapsed": round(snap["phase_elapsed"], 3),
+                "deadline": self.deadline, "suspects": suspects,
+                "heartbeat_table": table}
+
+    def _fire(self, snap: dict) -> None:
+        global _last_diagnosis
+        diag = self._diagnose(snap)
+        _last_diagnosis = diag
+        self._fired.set()
+        msg = format_timeout_message(diag["rank"], diag["iteration"],
+                                     diag["suspects"], diag["phase"],
+                                     self.deadline)
+        log.warning(f"watchdog: {msg}")
+        if self.diag_dir:
+            try:
+                os.makedirs(self.diag_dir, exist_ok=True)
+                with open(os.path.join(
+                        self.diag_dir,
+                        f"watchdog_rank{self.rank}.json"), "w") as fh:
+                    json.dump(diag, fh, indent=1)
+            except OSError:
+                pass
+        if self.supervised:
+            # a rank blocked inside a native collective cannot be unstuck
+            # from Python: exit with the watchdog code and let the
+            # supervisor tear down and relaunch the gang
+            import sys
+            sys.stderr.write(f"[watchdog] {msg}\n")
+            sys.stderr.flush()
+            os._exit(WATCHDOG_EXIT_CODE)
+        # unsupervised: asynchronously raise in the main thread. This lands
+        # as soon as the main thread runs Python bytecode again — it
+        # un-sticks Python-level stalls (the fault harness's hang loop, a
+        # slow host phase); a thread parked inside a native collective only
+        # sees it on return, which is the best Python can do without a
+        # supervisor process.
+        import ctypes
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_long(self._main_thread.ident),
+            ctypes.py_object(DistributedTimeoutError))
+
+    @property
+    def fired(self) -> bool:
+        return self._fired.is_set()
+
+
+class _Health:
+    """The per-training supervision bundle: optional heartbeat + optional
+    watchdog, started together by engine.train and stopped in its
+    finally."""
+
+    def __init__(self, heartbeat, watchdog):
+        self.heartbeat = heartbeat
+        self.watchdog = watchdog
+
+    def stop(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+        global _active_health
+        if _active_health is self:
+            _active_health = None
+
+
+_active_health: Optional[_Health] = None
+
+
+def start_health(config=None, heartbeat_addr: Optional[str] = None) -> _Health:
+    """Start training supervision for this process from config:
+
+    - a HeartbeatMonitor when ``heartbeat_interval`` > 0, this is a
+      multi-process run, and a side-channel address is known (the
+      LGBM_TPU_HEARTBEAT_ADDR env the supervisor sets, or
+      ``heartbeat_addr``);
+    - a CollectiveWatchdog when ``collective_deadline`` > 0.
+
+    Idempotent per training run; returns a handle whose ``stop()`` the
+    caller owns. With neither enabled the handle is inert."""
+    global _active_health
+    if _active_health is not None:
+        return _Health(None, None)    # nested train(): inert handle
+    import jax
+    interval = float(getattr(config, "heartbeat_interval", 0.0) or 0.0)
+    deadline = float(getattr(config, "collective_deadline", 0.0) or 0.0)
+    addr = heartbeat_addr or os.environ.get(_HEARTBEAT_ADDR_ENV)
+    try:
+        rank, nproc = jax.process_index(), jax.process_count()
+    except Exception:
+        rank, nproc = 0, 1
+    if interval > 0 or deadline > 0:
+        _progress.reset()   # fresh run: first-step compile exemption anew
+    heartbeat = None
+    if interval > 0 and nproc > 1 and addr:
+        try:
+            heartbeat = HeartbeatMonitor(rank, nproc, addr,
+                                         interval).start()
+        except OSError as e:
+            log.warning(f"heartbeat disabled: cannot reach side-channel "
+                        f"{addr}: {e}")
+    watchdog = None
+    if deadline > 0:
+        watchdog = CollectiveWatchdog(deadline, rank,
+                                      heartbeat=heartbeat).start()
+    health = _Health(heartbeat, watchdog)
+    if heartbeat is not None or watchdog is not None:
+        _active_health = health
+    return health
+
+
+def health_snapshot() -> dict:
+    """Health telemetry for bench.py JSON and checkpoint manifests:
+    restart count (from the supervisor's env), this process's progress,
+    and the per-rank heartbeat table when a monitor is live."""
+    snap = _progress.snapshot()
+    out = {
+        "restart_count": int(os.environ.get(_RESTART_COUNT_ENV, "0") or 0),
+        "last_iteration": snap["iter"],
+        "in_step_iteration": snap["step"],
+    }
+    h = _active_health
+    if h is not None and h.heartbeat is not None:
+        out["heartbeat"] = {str(r): {"iter": e.get("iter", -1),
+                                     "step": e.get("step", -1),
+                                     "age": e.get("age", -1.0)}
+                            for r, e in h.heartbeat.table().items()}
+        out["heartbeat_interval"] = h.heartbeat.interval
+    if h is not None and h.watchdog is not None:
+        out["collective_deadline"] = h.watchdog.deadline
+    return out
 
 
 def shutdown() -> None:

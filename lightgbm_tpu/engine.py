@@ -150,6 +150,19 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
     start_iter = 0
     if resume_from is not None:
+        import jax
+        if getattr(train_set, "is_pre_partitioned", False) \
+                and jax.process_count() > 1:
+            # pre-partitioned score caches are process-LOCAL; a rank-0
+            # checkpoint cannot restore them bit-identically on the other
+            # ranks. Replicate the data per worker (supervisor.
+            # train_supervised) for exact gang restart.
+            log.fatal("resume_from is not supported with multi-process "
+                      "pre-partitioned training: per-rank score caches are "
+                      "process-local, so a rank-0 checkpoint cannot restore "
+                      "the other ranks bit-identically. Use replicated-data "
+                      "distributed training (supervisor.train_supervised) "
+                      "for fault-tolerant multi-process runs.")
         from . import checkpoint as checkpoint_mod
         ckpt = checkpoint_mod.CheckpointManager(resume_from).load_latest_valid()
         if ckpt is None:
@@ -165,29 +178,39 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
             log.info(f"resumed from checkpoint {ckpt.path} at iteration "
                      f"{start_iter}")
 
+    from . import distributed
     from .utils import faults
     fault_plan = faults.plan_from(booster.config)
-    for i in range(start_iter, num_boost_round):
-        faults.maybe_kill(fault_plan, i)
-        for cb in cbs_before:
-            cb(CallbackEnv(model=booster, params=params, iteration=i,
-                           begin_iteration=0, end_iteration=num_boost_round,
-                           evaluation_result_list=None))
-        booster.update(fobj=fobj)
-
-        evaluation_result_list = []
-        if valid_sets or booster._boosting.config.is_provide_training_metric:
-            evaluation_result_list = booster.eval_set(feval)
-        try:
-            for cb in cbs_after:
+    # training supervision: heartbeat (multi-process liveness) and the
+    # collective_deadline watchdog — a dead/hung peer must surface as a
+    # diagnosable DistributedTimeoutError (or a supervised gang restart),
+    # never an indefinite collective stall
+    health = distributed.start_health(booster.config)
+    try:
+        for i in range(start_iter, num_boost_round):
+            faults.maybe_kill(fault_plan, i)
+            faults.maybe_hang(fault_plan, i)
+            for cb in cbs_before:
                 cb(CallbackEnv(model=booster, params=params, iteration=i,
                                begin_iteration=0, end_iteration=num_boost_round,
-                               evaluation_result_list=evaluation_result_list))
-        except EarlyStopException as es:
-            booster.best_iteration = es.best_iteration + 1
-            for item in es.best_score:
-                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-            break
+                               evaluation_result_list=None))
+            booster.update(fobj=fobj)
+
+            evaluation_result_list = []
+            if valid_sets or booster._boosting.config.is_provide_training_metric:
+                evaluation_result_list = booster.eval_set(feval)
+            try:
+                for cb in cbs_after:
+                    cb(CallbackEnv(model=booster, params=params, iteration=i,
+                                   begin_iteration=0, end_iteration=num_boost_round,
+                                   evaluation_result_list=evaluation_result_list))
+            except EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                for item in es.best_score:
+                    booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+                break
+    finally:
+        health.stop()
     return booster
 
 

@@ -1033,7 +1033,25 @@ class GBDT:
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (gbdt.cpp:369-452). Returns True when the
-        iteration could not add any tree with a split (early stoppable)."""
+        iteration could not add any tree with a split (early stoppable).
+
+        The body runs inside a watchdog phase: in multi-process training a
+        dead or hung peer stalls this step's collectives forever, so the
+        collective_deadline watchdog (distributed.CollectiveWatchdog) times
+        the fused/unfused step and converts an over-deadline stall into a
+        diagnosable DistributedTimeoutError / supervised gang restart."""
+        from .. import distributed
+        it = self.iter
+        distributed.notify_step_begin(it)
+        try:
+            return self._train_one_iter_watched(grad, hess)
+        finally:
+            # on success self.iter advanced past ``it``: record completion;
+            # on an exception the step did NOT complete and last_iter stays
+            distributed.notify_step_end(it if self.iter > it else it - 1)
+
+    def _train_one_iter_watched(self, grad: Optional[np.ndarray] = None,
+                                hess: Optional[np.ndarray] = None) -> bool:
         from ..utils import profiling
         cfg = self.config
         ts = self.train_set
@@ -1903,15 +1921,147 @@ class GBDT:
         through the train-time category lists BEFORE any array conversion
         (np.asarray on a category dtype would yield raw values, not codes).
         scipy sparse inputs pass through unchanged (binned column-wise
-        without densifying)."""
+        without densifying).
+
+        Input hardening: a wrong feature count, a non-numeric column, or a
+        non-finite value the trained bin mappers cannot route (NaN in a
+        feature trained without missing values; ±Inf in a feature whose
+        value range never saw it) raises a ValueError NAMING the offending
+        column/row — silently binning such values routes rows through
+        arbitrary thresholds and serves garbage scores. NaN in features
+        trained WITH missing handling (and in categorical features, whose
+        unseen values go to the other-bin by design) stays valid.
+        ``predict_disable_shape_check`` opts out of all of it (the
+        reference's escape hatch for intentionally truncated inputs)."""
         from ..basic import _is_scipy_sparse, _to_2d_float
+        validate = not self.config.predict_disable_shape_check
         if _is_scipy_sparse(X):
+            if validate:
+                self._validate_predict_matrix(X, sparse=True)
             return X
+        raw = X
         X = self.train_set._pandas_to_codes(X)
-        X = _to_2d_float(X)
+        try:
+            X = _to_2d_float(X)
+        except (ValueError, TypeError) as e:
+            self._raise_bad_dtype(raw, e)
         if X.ndim == 1:
             X = X.reshape(1, -1)
+        if validate:
+            self._validate_predict_matrix(X, sparse=False)
         return X
+
+    def _raise_bad_dtype(self, raw, cause) -> None:
+        """Name the first non-numeric column of a failed conversion."""
+        cols = None
+        if hasattr(raw, "dtypes"):          # pandas: dtypes are explicit
+            for ci, dt in enumerate(raw.dtypes):
+                if dt == object or str(dt).startswith(("datetime", "str")):
+                    cols = ci
+                    break
+        elif getattr(raw, "ndim", 0) == 2:
+            for ci in range(raw.shape[1]):
+                try:
+                    np.asarray(raw[:, ci], dtype=np.float64)
+                except (ValueError, TypeError):
+                    cols = ci
+                    break
+        where = f"feature column {cols}" if cols is not None \
+            else "the input"
+        raise ValueError(
+            f"predict input has non-numeric data in {where}: {cause}. "
+            f"Convert categoricals to codes (or pandas category dtype) "
+            f"before predicting.") from cause
+
+    def _validate_predict_matrix(self, X, sparse: bool) -> None:
+        """Shape + finiteness validation against the trained mappers."""
+        expected = self.train_set.num_total_features
+        if X.shape[1] != expected:
+            raise ValueError(
+                f"predict input has {X.shape[1]} feature columns but the "
+                f"model was trained with {expected} (set "
+                f"predict_disable_shape_check=true to bypass)")
+        mappers = self.train_set.mappers
+        if sparse:
+            # csr/csc/coo expose a flat numeric .data — check it in place;
+            # lil/dok hold object arrays of row lists that isfinite cannot
+            # take, so those canonicalize through coo (one copy)
+            data = getattr(X, "data", None)
+            flat = (data is not None and hasattr(data, "dtype")
+                    and data.dtype.kind in "fiu")
+            if flat and (data.size == 0 or bool(np.isfinite(data).all())):
+                return
+            coo = X.tocoo()
+            vals = np.asarray(coo.data, dtype=np.float64) \
+                if coo.nnz else np.zeros(0)
+            bad = ~np.isfinite(vals)          # walk only the offenders
+            for r, c, v in zip(coo.row[bad], coo.col[bad], vals[bad]):
+                self._check_nonfinite(float(v), int(r), int(c), mappers)
+            return
+        # fast path: one reduction — any NaN/Inf poisons the f64 sum (an
+        # inf pair cancels to NaN, which still fails isfinite); only on
+        # failure walk columns. A sum of large FINITE values can overflow
+        # to inf — the column scan then finds nothing and the input
+        # passes. Per-column work stays vectorized: legitimate
+        # missing-heavy inputs (NaN routed to missing bins) cost one
+        # isfinite pass, not a Python loop over every NaN.
+        with np.errstate(over="ignore", invalid="ignore"):
+            total = float(np.sum(X, dtype=np.float64))
+        if np.isfinite(total):
+            return
+        for c in range(X.shape[1]):
+            col = X[:, c]
+            if np.isfinite(col).all():
+                continue
+            # one representative per kind (NaN / +inf / -inf route
+            # differently) — each either raises or is valid for ALL
+            # entries of that kind in this column
+            nan_rows = np.flatnonzero(np.isnan(col))
+            if nan_rows.size:
+                self._check_nonfinite(np.nan, int(nan_rows[0]), c, mappers)
+            for sign in (np.inf, -np.inf):
+                rows = np.flatnonzero(col == sign)
+                if rows.size:
+                    self._check_nonfinite(sign, int(rows[0]), c, mappers)
+
+    def _check_nonfinite(self, v: float, row: int, col: int,
+                         mappers) -> None:
+        """Raise unless the trained mapper can route this non-finite
+        value (NaN -> missing bin / categorical other-bin / linear-leaf
+        fallback; Inf -> only if the training data contained it)."""
+        from .. import binning
+        m = mappers[col] if mappers and col < len(mappers) else None
+        if m is None:
+            return
+        if m.bin_type == binning.BIN_TYPE_CATEGORICAL:
+            return        # unseen/NaN categoricals route to the other-bin
+        if np.isnan(v):
+            if self.config.linear_tree:
+                # linear trees define NaN prediction: any NaN feature
+                # falls back to the leaf's constant output (reference:
+                # LeafOutputWithLinearModel's isnan check)
+                return
+            if m.missing_type == binning.MISSING_NONE and not m.is_trivial:
+                raise ValueError(
+                    f"predict input has NaN at row {row}, feature column "
+                    f"{col}, but the model was trained without missing "
+                    f"values in that feature — there is no bin to route "
+                    f"it to (set predict_disable_shape_check=true to "
+                    f"bin it arbitrarily)")
+            return
+        # +/-inf: valid only if the training data actually contained it;
+        # trivial (constant, unused-by-every-tree) features route nowhere
+        # and stay exempt like the NaN branch
+        if m.is_trivial:
+            return
+        seen = m.max_val if v > 0 else m.min_val
+        if not np.isinf(seen):
+            raise ValueError(
+                f"predict input has {v:+g} at row {row}, feature column "
+                f"{col}; the training data for that feature was bounded "
+                f"([{m.min_val:g}, {m.max_val:g}]) — an infinite value "
+                f"would bin to an arbitrary edge bin (set "
+                f"predict_disable_shape_check=true to allow)")
 
     def _stacked(self, num_iteration: Optional[int] = None) -> Optional[TreeArrays]:
         total_iters = len(self.trees) // self.num_tree_per_iteration

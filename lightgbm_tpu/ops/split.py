@@ -112,12 +112,24 @@ class BundleMeta(NamedTuple):
     candidate set (each original threshold once, with the member's
     most-frequent mass — reconstructed from the leaf totals — on the side
     its bin order dictates); built host-side in
-    basic.py _build_feature_meta_bundled."""
+    basic.py _build_feature_meta_bundled.
+
+    ``pref_fwd/pref_rev`` are the per-(column, bin, direction) TIE-BREAK
+    keys (higher wins among equal-gain candidates), built so the bundled
+    argmax reproduces the UNBUNDLED lexicographic order exactly: ordered
+    by the candidate's ORIGINAL owner feature (lowest index wins — a
+    bundle column interleaves several features' bins, so the plain
+    column-major preference would resolve a within-bundle tie to the
+    highest-offset member instead of the lowest feature, silently growing
+    a different tree than the unbundled run), then by the owner's own scan
+    direction and threshold order."""
     seg_lo: jax.Array        # int32 [F, B]
     seg_hi: jax.Array        # int32 [F, B]
     is_bundle: jax.Array     # bool [F]
     fwd_ok: jax.Array        # bool [F, B]
     rev_ok: jax.Array        # bool [F, B]
+    pref_fwd: jax.Array      # int32 [F, B]
+    pref_rev: jax.Array      # int32 [F, B]
 
 
 class SplitInfo(NamedTuple):
@@ -632,10 +644,18 @@ def find_best_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
     # Across features: lowest feature index wins ties
     # (serial_tree_learner.cpp:374-448 feature loop with strict operator>).
     gains = jnp.stack([gain_rev, gain_fwd], axis=2)          # [L, F, 2, B]
-    farange = jnp.arange(F, dtype=jnp.int32)[None, :, None, None]
-    tpref = jnp.stack([bins, (B - 1) - bins], axis=2)        # rev: high t; fwd: low t
-    pref = ((F - 1) - farange) * (4 * B) + jnp.stack(
-        [jnp.full_like(bins, 2 * B), jnp.zeros_like(bins)], axis=2) + tpref
+    if bundle is not None:
+        # bundled datasets: host-built preference tables ordered by each
+        # candidate's ORIGINAL owner feature + its unbundled scan order,
+        # so gain ties resolve exactly as the unbundled run's would (see
+        # BundleMeta docstring)
+        pref = jnp.stack([bundle.pref_rev, bundle.pref_fwd],
+                         axis=1)[None]                       # [1, F, 2, B]
+    else:
+        farange = jnp.arange(F, dtype=jnp.int32)[None, :, None, None]
+        tpref = jnp.stack([bins, (B - 1) - bins], axis=2)    # rev: high t; fwd: low t
+        pref = ((F - 1) - farange) * (4 * B) + jnp.stack(
+            [jnp.full_like(bins, 2 * B), jnp.zeros_like(bins)], axis=2) + tpref
 
     flat_gains = gains.reshape(L, -1)
     best_gain = jnp.max(flat_gains, axis=1)

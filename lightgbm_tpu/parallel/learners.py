@@ -78,7 +78,11 @@ def pad_bundle_meta(bundle_meta, f_pad: int):
                        constant_values=b - 1),
         is_bundle=jnp.pad(bundle_meta.is_bundle, (0, f_pad)),
         fwd_ok=jnp.pad(bundle_meta.fwd_ok, ((0, f_pad), (0, 0))),
-        rev_ok=jnp.pad(bundle_meta.rev_ok, ((0, f_pad), (0, 0))))
+        rev_ok=jnp.pad(bundle_meta.rev_ok, ((0, f_pad), (0, 0))),
+        # padded columns never produce valid candidates; preference 0
+        # keeps them below every real candidate
+        pref_fwd=jnp.pad(bundle_meta.pref_fwd, ((0, f_pad), (0, 0))),
+        pref_rev=jnp.pad(bundle_meta.pref_rev, ((0, f_pad), (0, 0))))
 
 
 def _pad_features(meta: FeatureMeta, f_pad: int) -> FeatureMeta:

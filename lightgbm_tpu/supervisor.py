@@ -1,0 +1,356 @@
+"""Gang supervisor: launch a multi-process training gang, watch it, and
+relaunch it from the latest valid checkpoint when a rank dies or hangs.
+
+The restart half of the training-supervision layer (the detection half —
+heartbeat + collective watchdog — lives in ``distributed.py``). The
+reference's answer to a mid-boost worker failure is operational: sockets
+time out (linkers_socket.cpp TimeOut), the job dies, an external scheduler
+restarts it and ``snapshot_freq`` models limit the loss. Here the whole
+loop is a library primitive, and PR 2's checkpoint subsystem makes the
+restart BIT-IDENTICAL: kill a rank at iteration k, the supervisor tears
+down the survivors, relaunches the gang, the gang resumes from the newest
+valid checkpoint, and the final model text equals the uninterrupted run's
+byte for byte (tests/test_supervisor.py proves it for kill, hang and
+kill-during-checkpoint-write).
+
+Usage — ``fn`` is a picklable ``fn(rank, *args)`` exactly as in
+``distributed.spawn``; it should train with a checkpoint callback AND
+``resume_from`` pointing at the same directory, so a relaunched
+incarnation continues instead of restarting. Every worker must hold the
+FULL dataset (replicated — the reference's ``pre_partition=false`` mode):
+that is what makes each rank's trainer state identical, so rank 0's
+checkpoint restores the whole gang bit-identically. Multi-process
+pre-partitioned datasets keep process-local score caches and are
+REJECTED by ``train(resume_from=...)``::
+
+    def work(rank, ckdir):
+        ds = lgb.Dataset(X_full, label=y_full)     # replicated per rank
+        booster = lgb.train(params, ds, rounds,
+                            callbacks=[lgb.checkpoint_callback(ckdir)],
+                            resume_from=ckdir)
+        return booster.model_to_string()
+
+    report = lgb.supervisor.run_supervised(work, nproc=2, args=(ckdir,),
+                                           checkpoint_dir=ckdir)
+    report.result      # rank 0's return value
+    report.restarts    # how many gang relaunches it took
+
+Children run with ``LGBM_TPU_SUPERVISED=1``: a rank whose collective
+watchdog fires exits with ``WATCHDOG_EXIT_CODE`` (writing a JSON diagnosis
+the supervisor folds into its report) instead of raising, since a rank
+stuck inside a native collective cannot be unstuck from Python. One-shot
+``LGBM_TPU_FAULT_*`` injections are stripped from relaunched incarnations
+(a kill-at-iteration-k fault would otherwise re-fire forever at the exact
+iteration the checkpoint resumes from); ``LGBM_TPU_RESTART_COUNT`` tells
+children (and their telemetry) which incarnation they are.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+from . import distributed
+from .utils import log
+from .utils import profiling
+
+# env vars whose faults are one-shot: armed for the FIRST incarnation only
+_FAULT_ENV_PREFIX = "LGBM_TPU_FAULT_"
+
+
+@dataclass
+class GangFailure:
+    """One failed gang incarnation: which rank(s) went down, how, and what
+    the watchdog diagnosis (if any) said."""
+    incarnation: int
+    failed_ranks: List[int]
+    exit_codes: dict
+    reason: str
+    watchdog: List[dict] = field(default_factory=list)
+
+    @property
+    def watchdog_fired(self) -> bool:
+        return bool(self.watchdog) or any(
+            c == distributed.WATCHDOG_EXIT_CODE
+            for c in self.exit_codes.values())
+
+
+@dataclass
+class SupervisorReport:
+    """Outcome of a supervised gang run."""
+    result: Any
+    restarts: int
+    failures: List[GangFailure]
+    wall_time: float
+
+
+class GangFailedError(RuntimeError):
+    """The gang kept failing past ``max_restarts``; carries the failure
+    history for diagnosis."""
+
+    def __init__(self, msg: str, failures: List[GangFailure]):
+        super().__init__(msg)
+        self.failures = failures
+
+
+def _read_diags(diag_dir: str) -> List[dict]:
+    import json
+    out = []
+    try:
+        names = sorted(os.listdir(diag_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("watchdog_rank"):
+            continue
+        try:
+            with open(os.path.join(diag_dir, name)) as fh:
+                out.append(json.load(fh))
+        except (OSError, ValueError):
+            pass
+        try:                              # consumed: one diag per failure
+            os.unlink(os.path.join(diag_dir, name))
+        except OSError:
+            pass
+    return out
+
+
+class _Incarnation:
+    """One launched gang: processes + result queue + env bookkeeping."""
+
+    def __init__(self, fn, nproc, args, per_rank_args, devices_per_proc,
+                 incarnation, heartbeat_port, diag_dir):
+        import multiprocessing as mp
+        self.nproc = nproc
+        port = distributed.free_port()
+        machines = ",".join(f"127.0.0.1:{port}" for _ in range(nproc))
+        ctx = mp.get_context("spawn")
+        self.q = ctx.Queue()
+        # children inherit os.environ at start(): install the supervision
+        # env, strip one-shot faults on relaunches, then restore
+        override = {
+            distributed._SUPERVISED_ENV: "1",
+            distributed._HEARTBEAT_ADDR_ENV: f"127.0.0.1:{heartbeat_port}",
+            distributed._DIAG_DIR_ENV: diag_dir,
+            distributed._RESTART_COUNT_ENV: str(incarnation),
+        }
+        removed = {}
+        if incarnation > 0:
+            for k in list(os.environ):
+                if k.startswith(_FAULT_ENV_PREFIX):
+                    removed[k] = os.environ.pop(k)
+        saved = {k: os.environ.get(k) for k in override}
+        os.environ.update(override)
+        try:
+            self.procs = [ctx.Process(
+                target=distributed._spawn_child,
+                args=(self.q, fn, r, nproc, machines, devices_per_proc,
+                      args if per_rank_args is None
+                      else (per_rank_args[r],) + tuple(args)))
+                for r in range(nproc)]
+            for p in self.procs:
+                p.start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            os.environ.update(removed)
+
+    def teardown(self) -> None:
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+        deadline = time.monotonic() + 10
+        for p in self.procs:
+            p.join(timeout=max(0.1, deadline - time.monotonic()))
+        for p in self.procs:
+            if p.is_alive():              # SIGTERM swallowed in native code
+                p.kill()
+                p.join(timeout=10)
+        self.q.close()
+        self.q.cancel_join_thread()
+
+
+def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
+                   per_rank_args: Optional[list] = None,
+                   devices_per_proc: Optional[int] = None,
+                   checkpoint_dir: Optional[str] = None,
+                   max_restarts: int = 2,
+                   timeout: Optional[float] = 600.0,
+                   diag_dir: Optional[str] = None) -> SupervisorReport:
+    """Run ``fn(rank, *args)`` as a supervised ``nproc``-process gang.
+
+    Like ``distributed.spawn`` but fault-tolerant: when any rank exits
+    nonzero (killed, crashed, or watchdog-tripped) the surviving ranks are
+    torn down and the WHOLE gang relaunches — ranks share compiled SPMD
+    programs, so a partial gang cannot continue — up to ``max_restarts``
+    times. ``fn`` is responsible for resuming from ``checkpoint_dir`` (via
+    ``train(resume_from=...)``); the supervisor guarantees relaunch, fault
+    disarming, the heartbeat side-channel, and failure diagnosis.
+
+    Args:
+      fn, nproc, args, per_rank_args, devices_per_proc: as in
+        ``distributed.spawn``.
+      checkpoint_dir: advisory — recorded in errors so an operator knows
+        where the resumable state lives.
+      max_restarts: gang relaunch budget (per run, not per rank).
+      timeout: per-incarnation deadline; a gang that neither finishes nor
+        fails within it counts as a failure (and is relaunched).
+      diag_dir: where ranks' watchdog diagnoses land (default: a
+        ``supervisor_diag`` dir inside checkpoint_dir, or a temp dir).
+
+    Returns a SupervisorReport with rank 0's result and the restart
+    history; raises GangFailedError after the budget is exhausted.
+    """
+    import queue as _queue
+    if per_rank_args is not None and len(per_rank_args) != nproc:
+        raise ValueError(f"per_rank_args has {len(per_rank_args)} entries "
+                         f"for {nproc} ranks")
+    if diag_dir is None:
+        if checkpoint_dir:
+            diag_dir = os.path.join(checkpoint_dir, "supervisor_diag")
+        else:
+            # no durable home for diagnoses: use a temp dir rather than
+            # littering the caller's cwd
+            import tempfile
+            diag_dir = tempfile.mkdtemp(prefix="lgbm_supervisor_diag_")
+    os.makedirs(diag_dir, exist_ok=True)
+    failures: List[GangFailure] = []
+    t0 = time.monotonic()
+    for incarnation in range(max_restarts + 1):
+        hb_port = distributed.free_port()
+        gang = _Incarnation(fn, nproc, args, per_rank_args,
+                            devices_per_proc, incarnation, hb_port,
+                            diag_dir)
+        profiling.set_gauge("supervisor_incarnation", incarnation)
+        results = {}
+        failure = None
+        dead_codes = {}
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while len(results) < nproc and failure is None:
+                try:
+                    rank, ok, payload = gang.q.get(timeout=0.5)
+                    if not ok:
+                        failure = (f"rank {rank} raised:\n"
+                                   f"{str(payload)[-2000:]}")
+                        dead_codes = {rank: None}
+                        break
+                    results[rank] = payload
+                    continue
+                except _queue.Empty:
+                    pass
+                # exit codes captured at DETECTION time: after teardown
+                # the healthy survivors we SIGTERM would also read as
+                # "died", obscuring which rank actually failed
+                dead_codes = {r: p.exitcode for r, p in enumerate(gang.procs)
+                              if r not in results and not p.is_alive()
+                              and p.exitcode not in (0, None)}
+                if dead_codes:
+                    kinds = ", ".join(
+                        f"rank {r} exit {c}"
+                        + (" (watchdog)" if c ==
+                           distributed.WATCHDOG_EXIT_CODE else "")
+                        for r, c in sorted(dead_codes.items()))
+                    failure = f"gang member(s) died: {kinds}"
+                    break
+                if deadline is not None and time.monotonic() > deadline:
+                    missing = [r for r in range(nproc) if r not in results]
+                    failure = (f"incarnation timed out after {timeout}s "
+                               f"waiting for ranks {missing}")
+                    break
+        finally:
+            gang.teardown()
+        if failure is None:
+            profiling.set_gauge("supervisor_restarts", incarnation)
+            return SupervisorReport(result=results.get(0),
+                                    restarts=incarnation,
+                                    failures=failures,
+                                    wall_time=time.monotonic() - t0)
+        diags = _read_diags(diag_dir)
+        rec = GangFailure(
+            incarnation=incarnation,
+            failed_ranks=sorted(dead_codes) or
+            [r for r in range(nproc) if r not in results],
+            exit_codes=dead_codes, reason=failure, watchdog=diags)
+        failures.append(rec)
+        sus = {s for d in diags for s in (d.get("suspects") or [])}
+        log.warning(
+            f"supervisor: incarnation {incarnation} failed ({failure})"
+            + (f"; watchdog suspects rank(s) "
+               f"{sorted(sus)} at iteration "
+               f"{max((d.get('iteration', -1) for d in diags), default=-1)}"
+               if diags else "")
+            + (f"; relaunching from {checkpoint_dir}"
+               if incarnation < max_restarts and checkpoint_dir else
+               ("; relaunching" if incarnation < max_restarts else "")))
+    profiling.set_gauge("supervisor_restarts", max_restarts + 1)
+    last = failures[-1]
+    raise GangFailedError(
+        f"gang failed {len(failures)} time(s), exceeding max_restarts="
+        f"{max_restarts}. Last failure: {last.reason}"
+        + (f" (watchdog diagnosis: "
+           f"{distributed.format_timeout_message(last.watchdog[0].get('rank'), last.watchdog[0].get('iteration'), last.watchdog[0].get('suspects'), last.watchdog[0].get('phase'), last.watchdog[0].get('deadline'))})"
+           if last.watchdog else "")
+        + (f". Resumable checkpoints: {checkpoint_dir}"
+           if checkpoint_dir else ""),
+        failures)
+
+
+def train_supervised(params: dict, data, label=None,
+                     num_boost_round: int = 100, nproc: int = 2,
+                     checkpoint_dir: str = "", checkpoint_period: int = 1,
+                     devices_per_proc: Optional[int] = None,
+                     timeout: Optional[float] = 900.0,
+                     **train_kwargs):
+    """Fault-tolerant distributed training: an ``nproc``-process gang over
+    REPLICATED data (every worker holds the full dataset and takes its
+    device shards through the data/voting/feature-parallel learners — the
+    reference's ``pre_partition=false`` mode), checkpointing every
+    ``checkpoint_period`` iterations and resuming BIT-IDENTICALLY across
+    gang restarts.
+
+    Replication is what makes the restart exact: with every rank's trainer
+    state identical (SPMD over replicated rows), rank 0's checkpoint
+    restores the whole gang. Pre-partitioned datasets keep process-LOCAL
+    score caches that a rank-0 checkpoint cannot restore on other ranks —
+    engine.train rejects that resume combination (see ``resume_from``).
+
+    Returns (Booster, SupervisorReport)."""
+    if not checkpoint_dir:
+        raise ValueError("train_supervised needs a checkpoint_dir")
+    params = dict(params or {})
+    params.setdefault("tree_learner", "data")
+    cfg_restarts = int(params.get("max_restarts", 2))
+    report = run_supervised(
+        _supervised_train_fn,
+        nproc=nproc,
+        args=(data, label, params, num_boost_round, checkpoint_dir,
+              checkpoint_period, dict(train_kwargs)),
+        devices_per_proc=devices_per_proc, checkpoint_dir=checkpoint_dir,
+        max_restarts=cfg_restarts, timeout=timeout)
+    from .booster import Booster
+    return Booster(params=params, model_str=report.result), report
+
+
+def _supervised_train_fn(rank, data, label, params, num_boost_round,
+                         checkpoint_dir, checkpoint_period, train_kwargs):
+    """Per-worker body of train_supervised (module-level so spawn can
+    pickle it): full replicated Dataset + checkpointed, resumable train —
+    every incarnation after the first resumes from the newest valid
+    checkpoint."""
+    from . import callback as callback_mod
+    from .basic import Dataset
+    from .engine import train as _train
+    ds = Dataset(data, label=label, params=dict(params),
+                 free_raw_data=False)
+    cbs = list(train_kwargs.pop("callbacks", []) or [])
+    cbs.append(callback_mod.checkpoint(checkpoint_dir,
+                                       period=checkpoint_period))
+    booster = _train(params, ds, num_boost_round, callbacks=cbs,
+                     resume_from=checkpoint_dir, **train_kwargs)
+    return booster.model_to_string()

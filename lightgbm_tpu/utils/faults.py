@@ -1,9 +1,11 @@
 """Fault-injection harness for resilience testing.
 
 Deterministic, opt-in failure points threaded through the training loop so
-the fault-tolerance suite (tests/test_fault_tolerance.py) can exercise the
-checkpoint/resume and numerics guard-rail machinery against REAL failure
-shapes — a hard kill mid-run (preemptible TPU fleets), a checkpoint
+the fault-tolerance suite (tests/test_fault_tolerance.py) and the gang
+supervisor suite (tests/test_supervisor.py) can exercise the
+checkpoint/resume, watchdog and gang-restart machinery against REAL failure
+shapes — a hard kill mid-run (preemptible TPU fleets), a rank that hangs
+and stalls every collective, a writer killed mid-checkpoint, a checkpoint
 truncated/corrupted on disk, and NaN gradients poisoning histograms —
 instead of only happy paths.
 
@@ -14,6 +16,15 @@ a child process without touching its config):
   LGBM_TPU_FAULT_KILL_AT_ITER=k       hard-exit (os._exit(137), no cleanup,
                                       like SIGKILL) at the START of 0-based
                                       boosting iteration k
+  LGBM_TPU_FAULT_HANG_AT_ITER=k       hang (interruptible sleep loop,
+                                      forever) at the start of iteration k
+  LGBM_TPU_FAULT_KILL_RANK_AT_ITER=r:k   kill ONLY process rank r at
+                                      iteration k (multi-process gangs)
+  LGBM_TPU_FAULT_HANG_RANK_AT_ITER=r:k   hang ONLY process rank r at
+                                      iteration k
+  LGBM_TPU_FAULT_KILL_IN_CKPT_WRITE=k hard-exit in the MIDDLE of the
+                                      checkpoint write for iteration k
+                                      (payload files written, manifest not)
   LGBM_TPU_FAULT_NAN_GRAD_AT_ITER=k   overwrite the first
                                       LGBM_TPU_FAULT_NAN_GRAD_COUNT (default
                                       8) gradient values with NaN at
@@ -22,6 +33,8 @@ a child process without touching its config):
                                       model text right after it is written
                                       (simulates on-disk corruption)
 
+The rank-targeted forms resolve the process rank lazily through
+``jax.process_index()`` so the plan can be built before distributed init.
 With no fault armed the plan is ``None`` and every hook is a single
 attribute check — zero cost on the training path.
 """
@@ -30,8 +43,9 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Tuple
 
 _KILL_EXIT_CODE = 137   # 128 + SIGKILL: what a preemption/oom kill reports
 
@@ -39,6 +53,10 @@ _KILL_EXIT_CODE = 137   # 128 + SIGKILL: what a preemption/oom kill reports
 @dataclass
 class FaultPlan:
     kill_at_iter: int = -1
+    hang_at_iter: int = -1
+    kill_rank_at_iter: Optional[Tuple[int, int]] = None   # (rank, iter)
+    hang_rank_at_iter: Optional[Tuple[int, int]] = None   # (rank, iter)
+    kill_in_ckpt_write: int = -1
     nan_grad_at_iter: int = -1
     nan_grad_count: int = 8
     corrupt_checkpoint: bool = False
@@ -56,6 +74,21 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_rank_iter(name: str) -> Optional[Tuple[int, int]]:
+    """Parse an "r:k" rank-targeted fault env var; None when unset or
+    malformed (a malformed value must not silently kill rank 0)."""
+    v = os.environ.get(name, "")
+    if not v:
+        return None
+    try:
+        r, _, k = v.partition(":")
+        return (int(r), int(k))
+    except ValueError:
+        sys.stderr.write(f"[faults] ignoring malformed {name}={v!r} "
+                         f"(want rank:iter)\n")
+        return None
+
+
 def plan_from(config=None) -> Optional[FaultPlan]:
     """Build the active fault plan from config fields overridden by the
     LGBM_TPU_FAULT_* environment; None when nothing is armed."""
@@ -64,6 +97,12 @@ def plan_from(config=None) -> Optional[FaultPlan]:
     plan = FaultPlan(
         kill_at_iter=_env_int("LGBM_TPU_FAULT_KILL_AT_ITER",
                               int(get("fault_kill_at_iter", -1))),
+        hang_at_iter=_env_int("LGBM_TPU_FAULT_HANG_AT_ITER",
+                              int(get("fault_hang_at_iter", -1))),
+        kill_rank_at_iter=_env_rank_iter("LGBM_TPU_FAULT_KILL_RANK_AT_ITER"),
+        hang_rank_at_iter=_env_rank_iter("LGBM_TPU_FAULT_HANG_RANK_AT_ITER"),
+        kill_in_ckpt_write=_env_int("LGBM_TPU_FAULT_KILL_IN_CKPT_WRITE",
+                                    int(get("fault_kill_in_ckpt_write", -1))),
         nan_grad_at_iter=_env_int("LGBM_TPU_FAULT_NAN_GRAD_AT_ITER",
                                   int(get("fault_nan_grad_at_iter", -1))),
         nan_grad_count=_env_int("LGBM_TPU_FAULT_NAN_GRAD_COUNT", 8),
@@ -74,21 +113,72 @@ def plan_from(config=None) -> Optional[FaultPlan]:
             if "LGBM_TPU_FAULT_CORRUPT_CHECKPOINT" in os.environ
             else bool(get("fault_corrupt_checkpoint", False))),
     )
-    if (plan.kill_at_iter < 0 and plan.nan_grad_at_iter < 0
+    if (plan.kill_at_iter < 0 and plan.hang_at_iter < 0
+            and plan.kill_rank_at_iter is None
+            and plan.hang_rank_at_iter is None
+            and plan.kill_in_ckpt_write < 0
+            and plan.nan_grad_at_iter < 0
             and not plan.corrupt_checkpoint):
         return None
     return plan
 
 
+def _process_rank() -> int:
+    import jax
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def _hard_exit(context: str) -> None:
+    """``os._exit`` skips atexit/finally so nothing gets the chance to
+    'finish' a write (the SIGKILL shape a preempted worker actually sees)."""
+    sys.stderr.write(f"[faults] killing process {context}\n")
+    sys.stderr.flush()
+    os._exit(_KILL_EXIT_CODE)
+
+
 def maybe_kill(plan: Optional[FaultPlan], iteration: int) -> None:
-    """Hard-exit at the armed iteration — ``os._exit`` skips atexit/finally
-    so nothing gets the chance to 'finish' a write (the SIGKILL shape a
-    preempted worker actually sees)."""
-    if plan is not None and plan.kill_at_iter == iteration:
-        sys.stderr.write(
-            f"[faults] killing process at iteration {iteration}\n")
-        sys.stderr.flush()
-        os._exit(_KILL_EXIT_CODE)
+    """Hard-exit at the armed iteration (optionally rank-targeted)."""
+    if plan is None:
+        return
+    if plan.kill_at_iter == iteration:
+        _hard_exit(f"at iteration {iteration}")
+    if plan.kill_rank_at_iter is not None \
+            and plan.kill_rank_at_iter[1] == iteration \
+            and plan.kill_rank_at_iter[0] == _process_rank():
+        _hard_exit(f"(rank {plan.kill_rank_at_iter[0]}) at iteration "
+                   f"{iteration}")
+
+
+def maybe_hang(plan: Optional[FaultPlan], iteration: int) -> None:
+    """Hang forever at the armed iteration (optionally rank-targeted) in an
+    INTERRUPTIBLE short-sleep loop: the loop re-enters Python bytecode
+    every tick, so the watchdog's asynchronous DistributedTimeoutError can
+    land, and a supervisor SIGTERM still kills the process."""
+    if plan is None:
+        return
+    hang = plan.hang_at_iter == iteration
+    if not hang and plan.hang_rank_at_iter is not None \
+            and plan.hang_rank_at_iter[1] == iteration:
+        hang = plan.hang_rank_at_iter[0] == _process_rank()
+    if not hang:
+        return
+    sys.stderr.write(f"[faults] hanging rank {_process_rank()} at "
+                     f"iteration {iteration}\n")
+    sys.stderr.flush()
+    while True:
+        time.sleep(0.05)
+
+
+def maybe_kill_in_ckpt_write(plan: Optional[FaultPlan],
+                             iteration: int) -> None:
+    """Kill the checkpoint WRITER between the payload writes and the
+    manifest write — the mid-write crash the manifest-last protocol and the
+    .tmp staging directory must make harmless."""
+    if plan is not None and plan.kill_in_ckpt_write == iteration:
+        _hard_exit(f"inside checkpoint write for iteration {iteration}")
 
 
 def maybe_nan_grad(plan: Optional[FaultPlan], iteration: int, g, h):

@@ -45,6 +45,7 @@ def reset() -> None:
     _cnt.clear()
     _counters.clear()
     _counter_cnt.clear()
+    _gauges.clear()
 
 
 def counter(name: str, value: float) -> None:
@@ -60,6 +61,23 @@ def counter(name: str, value: float) -> None:
 def counters() -> Dict[str, float]:
     """Accumulated named counters (empty when profiling is disabled)."""
     return dict(_counters)
+
+
+# Health gauges: last-value-wins instruments (heartbeat age, supervisor
+# restart count, per-rank last iteration) — unlike the timers/counters
+# these are ALWAYS on (a restart count that only records under TIMETAG
+# would be useless for postmortems) and cost one dict store.
+_gauges: Dict[str, float] = {}
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Record the current value of a named health gauge."""
+    _gauges[name] = float(value)
+
+
+def gauges() -> Dict[str, float]:
+    """Current gauge values (supervisor restarts, heartbeat ages, ...)."""
+    return dict(_gauges)
 
 
 @contextmanager

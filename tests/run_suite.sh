@@ -8,4 +8,8 @@ for f in "$(dirname "$0")"/test_*.py; do
   echo "=== $f"
   python -u -m pytest "$f" -q --no-header || fail=1
 done
+# supervisor gang-restart smoke (fast knobs, ~30 s): kill a rank mid-iter,
+# relaunch from checkpoint, assert bit-identical final model
+echo "=== scripts/supervisor_smoke.py"
+python -u "$(dirname "$0")/../scripts/supervisor_smoke.py" || fail=1
 exit $fail

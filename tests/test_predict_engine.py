@@ -64,9 +64,17 @@ def _legacy_raw(booster, X):
 # ----------------------------------------------------------- bit parity
 @pytest.mark.parametrize("extra,label", [
     ({}, "y"),                                                   # gbdt
-    ({"boosting": "dart", "drop_rate": 0.5}, "y"),               # dart
+    # dart/OVA exercise the SAME engine machinery (stacked traversal +
+    # f64 carry; dart's tree scaling and OVA's conversion live upstream
+    # of the engine): tier-1 keeps the gbdt + multiclass pair, the other
+    # two boosting/objective spellings ride the slow tier (PR 5 budget
+    # taming; their unique surfaces stay covered by test_boosting_modes
+    # and test_objective_matrix)
+    pytest.param({"boosting": "dart", "drop_rate": 0.5}, "y",
+                 marks=pytest.mark.slow),                        # dart
     ({"objective": "multiclass", "num_class": 3}, "y3"),         # softmax
-    ({"objective": "multiclassova", "num_class": 3}, "y3"),      # OVA
+    pytest.param({"objective": "multiclassova", "num_class": 3}, "y3",
+                 marks=pytest.mark.slow),                        # OVA
 ])
 def test_engine_bit_parity(data, extra, label):
     X, y, y3 = data
@@ -362,3 +370,98 @@ def test_eval_on_valid_routes_through_engine(data, dispatch_hook):
     cached = np.asarray(g._valid_scores[0], np.float64)
     rescored = np.asarray(g.score_dataset(dva), np.float64)
     np.testing.assert_allclose(cached, rescored, rtol=1e-5, atol=1e-6)
+
+
+# ==================================================== input hardening
+class TestPredictInputHardening:
+    """predict on malformed raw features fails LOUDLY, naming the
+    offending column/row, instead of silently binning garbage. NaN stays
+    valid wherever the trained mappers can route it (missing bins,
+    categorical other-bin); predict_disable_shape_check opts out."""
+
+    @pytest.fixture(scope="class")
+    def booster_nomissing(self):
+        """Model trained WITHOUT missing values: NaN at predict has no
+        bin to route to."""
+        rng = np.random.RandomState(3)
+        X = rng.normal(size=(400, 5))
+        y = (X[:, 0] > 0).astype(np.float64)
+        return _train(X, y, {}, nround=3), X
+
+    def test_wrong_feature_count(self, booster_nomissing):
+        b, X = booster_nomissing
+        with pytest.raises(ValueError, match=r"4 feature columns.*5"):
+            b.predict(X[:, :4])
+        with pytest.raises(ValueError, match=r"7 feature columns.*5"):
+            b.predict(np.hstack([X, X[:, :2]]))
+
+    def test_wrong_dtype_names_column(self, booster_nomissing):
+        b, X = booster_nomissing
+        bad = X[:3].astype(object)
+        bad[1, 2] = "not-a-number"
+        with pytest.raises(ValueError, match=r"non-numeric"):
+            b.predict(bad)
+
+    def test_nan_on_nomissing_model_names_row_and_column(
+            self, booster_nomissing):
+        b, X = booster_nomissing
+        bad = X[:10].copy()
+        bad[4, 2] = np.nan
+        with pytest.raises(ValueError, match=r"NaN at row 4, feature "
+                                             r"column 2"):
+            b.predict(bad)
+
+    def test_inf_names_row_and_column(self, booster_nomissing):
+        b, X = booster_nomissing
+        bad = X[:10].copy()
+        bad[7, 1] = np.inf
+        with pytest.raises(ValueError, match=r"\+inf at row 7, feature "
+                                             r"column 1"):
+            b.predict(bad)
+        bad = X[:10].copy()
+        bad[2, 3] = -np.inf
+        with pytest.raises(ValueError, match=r"-inf at row 2, feature "
+                                             r"column 3"):
+            b.predict(bad)
+
+    def test_inf_in_sparse_input(self):
+        sp = pytest.importorskip("scipy.sparse")
+        rng = np.random.RandomState(5)
+        X = sp.random(500, 30, density=0.05, random_state=rng,
+                      format="csr",
+                      data_rvs=lambda k: rng.uniform(0.5, 2.0, k))
+        y = (np.asarray(X.sum(axis=1)).ravel() > 0.2).astype(np.float64)
+        b = _train(X, y, {}, nround=3)
+        bad = X[:20].tolil()
+        bad[3, 11] = np.inf
+        with pytest.raises(ValueError, match=r"row 3, feature column 11"):
+            b.predict(bad.tocsr())
+
+    # NaN-with-missing-routing staying valid needs no dedicated test:
+    # every parity test in this file predicts the module fixture's
+    # NaN-laden X through the hardened entry point.
+
+    def test_nan_valid_in_categorical_column(self):
+        """NaN/unseen categoricals route to the other-bin by design."""
+        rng = np.random.RandomState(9)
+        X = rng.normal(size=(400, 3))
+        X[:, 1] = rng.randint(0, 5, size=400)
+        y = (X[:, 0] + (X[:, 1] == 2) > 0.5).astype(np.float64)
+        p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 10,
+             "verbosity": -1}
+        ds = lgb.Dataset(X, label=y, params=p, categorical_feature=[1])
+        b = lgb.train(p, ds, 3)
+        bad = X[:5].copy()
+        bad[2, 1] = np.nan                      # categorical: allowed
+        assert np.isfinite(b.predict(bad)).all()
+
+    def test_disable_shape_check_opts_out(self):
+        """predict_disable_shape_check=true restores the old bin-whatever
+        behavior (the reference's escape hatch)."""
+        rng = np.random.RandomState(3)
+        Xf = rng.normal(size=(400, 5))
+        yf = (Xf[:, 0] > 0).astype(np.float64)
+        bf = _train(Xf, yf, {"predict_disable_shape_check": True}, nround=3)
+        bad = Xf[:10].copy()
+        bad[4, 2] = np.nan
+        assert np.isfinite(bf.predict(bad)).all()   # no raise: binned as-is
