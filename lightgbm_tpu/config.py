@@ -231,6 +231,13 @@ class Config:
     # how many recent checkpoints to retain (>= 2 keeps a fallback when the
     # newest is truncated/corrupt)
     checkpoint_keep: int = 2
+    # sharded checkpoint layout for pre-partitioned datasets: every rank
+    # writes its process-local score-cache shard (shard_rank{r}.pkl) plus a
+    # rank-0 PARTITION.json row-partition manifest, enabling resume at a
+    # DIFFERENT world size (re-partition-on-load) and supervisor gang
+    # shrink; off falls back to the replicated rank-0-only layout (which
+    # pre-partitioned multi-process runs cannot resume from)
+    checkpoint_shards: bool = True
 
     # Distributed training supervision (see lightgbm_tpu/supervisor.py)
     # seconds between liveness heartbeats each rank sends to rank 0 over
@@ -245,6 +252,14 @@ class Config:
     # how many times the gang supervisor relaunches a failed gang from the
     # latest valid checkpoint before giving up
     max_restarts: int = 2
+    # per-rank restart budget: once the SAME rank has failed more than this
+    # many times at the current world size (or its spawn itself fails), the
+    # supervisor classifies it permanently lost and relaunches the gang at
+    # world size n-1 (a gang SHRINK) instead of burning same-size restarts
+    rank_restart_budget: int = 1
+    # the smallest world size the supervisor may shrink a gang to; a loss
+    # that would go below it exhausts the restart budget instead
+    min_world_size: int = 1
 
     # Fault injection (testing)
     # hard-exit (like SIGKILL) at the start of this 0-based iteration;
@@ -253,9 +268,22 @@ class Config:
     # sleep forever (interruptibly) at the start of this 0-based iteration
     # — the hung-rank shape the collective_deadline watchdog must catch
     fault_hang_at_iter: int = -1
+    # hard-exit ONLY process rank r at 0-based iteration k ("r:k"; the
+    # config twin of LGBM_TPU_FAULT_KILL_RANK_AT_ITER — unlike the env
+    # form, the supervisor's one-shot fault stripping cannot disarm it)
+    fault_kill_rank_at_iter: str = ""
+    # hang ONLY process rank r at 0-based iteration k ("r:k")
+    fault_hang_rank_at_iter: str = ""
     # hard-exit in the middle of the checkpoint write for this 0-based
     # iteration (after the payload files, before the manifest)
     fault_kill_in_ckpt_write: int = -1
+    # hard-exit rank r mid-way through the SHARDED checkpoint write for
+    # 0-based iteration k ("r:k": after its shard file, before the
+    # shard-metadata exchange)
+    fault_kill_in_shard_write: str = ""
+    # flip bytes in rank r's shard file of every sharded checkpoint right
+    # after publication (manifest intact: only checksums catch it)
+    fault_corrupt_shard: int = -1
     # overwrite leading gradient values with NaN at this 0-based iteration
     fault_nan_grad_at_iter: int = -1
     # flip bytes in each checkpoint's model text right after it is written

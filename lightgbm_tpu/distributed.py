@@ -35,7 +35,7 @@ import os
 import socket
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from .utils import log
 
@@ -45,6 +45,12 @@ _initialized = False
 # distinct from the fault harness's 137 kill so the supervisor can tell
 # "rank died" from "rank declared the gang stalled"
 WATCHDOG_EXIT_CODE = 97
+
+# exit code a spawned child uses when it could not even come up (spawn/
+# bootstrap failure before distributed init) — the supervisor classifies
+# the rank as PERMANENTLY lost and shrinks the gang instead of burning
+# same-size restarts on a machine that cannot start
+SPAWN_FAIL_EXIT_CODE = 96
 
 
 def is_initialized() -> bool:
@@ -307,6 +313,135 @@ def barrier(name: str = "barrier", timeout: Optional[float] = None) -> None:
                 raise
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(name)
+
+
+def _coordination_client():
+    """The jax distributed coordination-service client (pure gRPC — works
+    on every backend, including this container's CPU backend that cannot
+    run cross-process XLA computations); None single-process or when jax
+    exposes no client."""
+    import jax
+    if jax.process_count() <= 1:
+        return None
+    try:
+        from jax._src import distributed as jax_dist
+        return jax_dist.global_state.client
+    except Exception:
+        return None
+
+
+_exchange_seq = 0
+
+
+def exchange_host(tag: str, payload: str,
+                  timeout: Optional[float] = None) -> List[str]:
+    """Allgather a SMALL host-side string across processes, returning the
+    per-rank payloads in rank order. This is the swappable collective
+    floor the sharded-checkpoint protocol stands on: it prefers the
+    coordination-service key-value store (pure gRPC, like ``barrier``), so
+    it works even where cross-process XLA collectives don't (this
+    container's CPU backend), and falls back to
+    ``multihost_utils.process_allgather`` on clusters without a
+    coordination client. Single-process: returns ``[payload]``.
+
+    Callers must invoke it in lockstep on every rank with the same
+    ``tag`` (keys are sequence-numbered per process, so lockstep keeps
+    them agreed). Payloads should stay small (shard metadata, row counts
+    — not data)."""
+    global _exchange_seq
+    import jax
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return [payload]
+    rank = jax.process_index()
+    client = _coordination_client()
+    wd = _active_health.watchdog if _active_health is not None else None
+    if timeout is None:
+        timeout = wd.deadline if wd is not None else 600.0
+    with watchdog_phase(f"exchange:{tag}"):
+        if client is not None:
+            _exchange_seq += 1
+            prefix = f"lgbm_tpu_xchg/{tag}/{_exchange_seq}"
+            client.key_value_set(f"{prefix}/r{rank}", payload)
+            out = []
+            for r in range(nproc):
+                out.append(client.blocking_key_value_get(
+                    f"{prefix}/r{r}", int(timeout * 1000)))
+            # NO cleanup: deleting a key here races peers that have not
+            # read it yet (their blocking get would then wait out the full
+            # timeout and fail a healthy gang). Keys are sequence-
+            # namespaced and the KV store lives only as long as the gang's
+            # coordination service, so the leak is bounded and harmless.
+            return out
+        # no coordination client: fall back to an XLA-level allgather of
+        # the utf-8 bytes padded to the max length
+        import numpy as np
+        from jax.experimental import multihost_utils
+        raw = payload.encode()
+        ln = np.asarray([len(raw)], np.int32)
+        lens = np.asarray(multihost_utils.process_allgather(ln)).reshape(-1)
+        width = max(1, int(lens.max()))
+        buf = np.zeros((width,), np.uint8)
+        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+        gathered = np.asarray(
+            multihost_utils.process_allgather(buf)).reshape(nproc, width)
+        return [bytes(gathered[r, :int(lens[r])].tobytes()).decode()
+                for r in range(nproc)]
+
+
+def repartition_rows(old_ranges, row_start: int, row_count: int,
+                     fetch_shard):
+    """Reassemble one rank's row slice ``[row_start, row_start+row_count)``
+    of a globally row-partitioned array from shards written under a
+    DIFFERENT (or the same) partition — the load half of resume-at-a-
+    different-world-size.
+
+    Args:
+      old_ranges: per-old-rank ``(row_start, row_count)`` pairs in rank
+        order, tiling ``[0, sum(counts))`` contiguously.
+      row_start, row_count: the slice the calling rank needs under the NEW
+        partition.
+      fetch_shard: ``fetch_shard(old_rank) -> np.ndarray`` returning that
+        old rank's shard array (rows first). Called ONLY for old shards
+        that overlap the requested slice, so a same-partition resume
+        touches exactly its own shard.
+
+    Returns the concatenated rows (np.ndarray), bit-identical to the
+    original global array's slice — re-partitioning is pure row movement,
+    so resume at any world size starts from the exact same per-row state.
+    Raises ValueError when the old ranges do not tile the requested slice.
+    """
+    import numpy as np
+    lo, hi = int(row_start), int(row_start) + int(row_count)
+    if row_count == 0:
+        # preserve trailing dims + dtype (multiclass caches are [n, k]):
+        # an empty slice must still merge cleanly with non-empty peers
+        if old_ranges:
+            return fetch_shard(0)[:0]
+        return np.zeros((0,), np.float32)
+    pieces = []
+    covered = lo
+    for old_rank, (s, c) in enumerate(old_ranges):
+        s, e = int(s), int(s) + int(c)
+        if e <= lo or s >= hi:
+            continue
+        a, b = max(s, lo), min(e, hi)
+        if a != covered:
+            raise ValueError(
+                f"shard ranges do not tile rows [{lo}, {hi}): gap at row "
+                f"{covered} (old rank {old_rank} covers [{s}, {e}))")
+        shard = fetch_shard(old_rank)
+        if shard.shape[0] != c:
+            raise ValueError(
+                f"shard for old rank {old_rank} has {shard.shape[0]} rows, "
+                f"its recorded partition says {c}")
+        pieces.append(shard[a - s:b - s])
+        covered = b
+    if covered != hi:
+        raise ValueError(
+            f"shard ranges do not tile rows [{lo}, {hi}): rows "
+            f"[{covered}, {hi}) are not covered by any shard")
+    return pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)
 
 
 def _barrier_timed_out(name: str, wd, cause) -> None:
@@ -1009,6 +1144,11 @@ def prepare_cpu_device_env(env, devices_per_proc: int) -> None:
 
 def _spawn_child(q, fn, rank, nproc, machines, devices_per_proc, args):
     import traceback
+    from .utils import faults
+    # spawn-fail injection point: the child dies BEFORE bootstrap (the
+    # "machine cannot start" shape — bad image, dead host, lost quota) so
+    # the supervisor's permanent-loss classification can be exercised
+    faults.maybe_fail_spawn(rank)
     try:
         if devices_per_proc is not None:
             prepare_cpu_device_env(os.environ, devices_per_proc)
@@ -1236,6 +1376,13 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
     ds.raw_data_np = None
     ds.is_pre_partitioned = True
     ds.num_local_data = n_local
+    # global row partition bookkeeping for sharded checkpoints: this
+    # rank's first global row and every rank's local row count (the
+    # PARTITION.json the checkpoint writer records; see checkpoint.py)
+    rank = jax.process_index()
+    counts = [int(c) for c in np.asarray(local_counts).reshape(-1)]
+    ds.partition_counts = counts
+    ds.local_row_start = int(sum(counts[:rank]))
     ds._constructed = True
     if ds.free_raw_data:
         ds.data = None

@@ -150,19 +150,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
 
     start_iter = 0
     if resume_from is not None:
-        import jax
-        if getattr(train_set, "is_pre_partitioned", False) \
-                and jax.process_count() > 1:
-            # pre-partitioned score caches are process-LOCAL; a rank-0
-            # checkpoint cannot restore them bit-identically on the other
-            # ranks. Replicate the data per worker (supervisor.
-            # train_supervised) for exact gang restart.
-            log.fatal("resume_from is not supported with multi-process "
-                      "pre-partitioned training: per-rank score caches are "
-                      "process-local, so a rank-0 checkpoint cannot restore "
-                      "the other ranks bit-identically. Use replicated-data "
-                      "distributed training (supervisor.train_supervised) "
-                      "for fault-tolerant multi-process runs.")
+        # pre-partitioned runs resume from SHARDED checkpoints: each rank
+        # reassembles its process-local score caches from the shard files
+        # under the current partition (checkpoint.restore_booster), so the
+        # gang may even come back at a different world size; a legacy
+        # rank-0-only checkpoint is rejected there with a clear error.
         from . import checkpoint as checkpoint_mod
         ckpt = checkpoint_mod.CheckpointManager(resume_from).load_latest_valid()
         if ckpt is None:

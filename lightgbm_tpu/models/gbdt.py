@@ -64,6 +64,20 @@ def _bagging_mask(key: jax.Array, frac, n: int) -> jax.Array:
     return (u < frac).astype(jnp.float32)
 
 
+@functools.partial(jax.jit, static_argnames=("n",))
+def _bagging_mask_rows(key: jax.Array, frac, row_start, n: int) -> jax.Array:
+    """Bagging mask for pre-partitioned runs, keyed per GLOBAL row
+    (fold_in(key, global_row) -> one uniform draw each): every row's
+    keep/drop decision depends only on the period key and the row's global
+    index, never on how rows are split across processes — so a gang
+    resumed at a DIFFERENT world size re-derives the exact same sample
+    the original partition drew (checkpoint.py's elastic resume)."""
+    rows = row_start + jnp.arange(n)
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, rows)
+    u = jax.vmap(lambda k: jax.random.uniform(k, ()))(keys)
+    return (u < frac).astype(jnp.float32)
+
+
 @jax.jit
 def _linear_valid_delta(leaf: jax.Array, leaf_value: jax.Array,
                         const: jax.Array, W: jax.Array, used: jax.Array,
@@ -655,8 +669,16 @@ class GBDT:
             self._bag_sub = (sub_idx, sub_bins, sub_binsT)
             return
         self._bag_sub = None
-        self._bag_mask = _bagging_mask(key, self._bagging_frac(),
-                                       self._n_score_rows)
+        if self._pre_part:
+            # per-global-row draw: partition-invariant, so an elastic
+            # resume at a different world size re-derives the same sample
+            self._bag_mask = _bagging_mask_rows(
+                key, self._bagging_frac(),
+                jnp.int32(getattr(self.train_set, "local_row_start", 0) or 0),
+                self._n_score_rows)
+        else:
+            self._bag_mask = _bagging_mask(key, self._bagging_frac(),
+                                           self._n_score_rows)
 
     def _feature_mask(self) -> jax.Array:
         """Per-tree column sampling (reference: col_sampler.hpp:20-50

@@ -43,6 +43,18 @@ stuck inside a native collective cannot be unstuck from Python. One-shot
 (a kill-at-iteration-k fault would otherwise re-fire forever at the exact
 iteration the checkpoint resumes from); ``LGBM_TPU_RESTART_COUNT`` tells
 children (and their telemetry) which incarnation they are.
+
+ELASTIC gangs: a rank whose spawn itself fails (exit
+``SPAWN_FAIL_EXIT_CODE``), or that keeps failing past the per-rank
+``rank_restart_budget`` at one world size, is classified PERMANENTLY lost
+— the supervisor then relaunches the gang at world size n-1 (down to
+``min_world_size``) instead of giving up, recording a ``GangShrink`` in
+the report and the ``supervisor_world_size`` gauge. Ranks renumber to
+``0..n-2``, so ``fn`` should derive its data slice from
+``jax.process_index()/process_count()`` AFTER distributed init;
+pre-partitioned runs resume across the shrink because sharded checkpoints
+re-partition their per-rank score-cache shards onto the new world size on
+load (see lightgbm_tpu/checkpoint.py).
 """
 
 from __future__ import annotations
@@ -69,12 +81,33 @@ class GangFailure:
     exit_codes: dict
     reason: str
     watchdog: List[dict] = field(default_factory=list)
+    world_size: int = 0               # nproc of this incarnation
 
     @property
     def watchdog_fired(self) -> bool:
         return bool(self.watchdog) or any(
             c == distributed.WATCHDOG_EXIT_CODE
             for c in self.exit_codes.values())
+
+    @property
+    def spawn_failed_ranks(self) -> List[int]:
+        """Ranks whose process never came up (exit SPAWN_FAIL_EXIT_CODE):
+        classified permanently lost without burning the per-rank budget."""
+        return sorted(r for r, c in self.exit_codes.items()
+                      if c == distributed.SPAWN_FAIL_EXIT_CODE)
+
+
+@dataclass
+class GangShrink:
+    """One gang-shrink event: the supervisor classified rank(s) as
+    permanently lost and relaunched the gang at a smaller world size (the
+    surviving data/ranks renumber to 0..to_nproc-1; a sharded checkpoint
+    re-partitions on load, see checkpoint.py)."""
+    incarnation: int                  # the incarnation that FAILED
+    from_nproc: int
+    to_nproc: int
+    lost_ranks: List[int]             # ranks (old numbering) given up on
+    reason: str
 
 
 @dataclass
@@ -84,6 +117,8 @@ class SupervisorReport:
     restarts: int
     failures: List[GangFailure]
     wall_time: float
+    world_size: int = 0               # nproc the gang FINISHED at
+    shrinks: List[GangShrink] = field(default_factory=list)
 
 
 class GangFailedError(RuntimeError):
@@ -181,8 +216,11 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
                    checkpoint_dir: Optional[str] = None,
                    max_restarts: int = 2,
                    timeout: Optional[float] = 600.0,
-                   diag_dir: Optional[str] = None) -> SupervisorReport:
-    """Run ``fn(rank, *args)`` as a supervised ``nproc``-process gang.
+                   diag_dir: Optional[str] = None,
+                   rank_restart_budget: int = 1,
+                   min_world_size: int = 1) -> SupervisorReport:
+    """Run ``fn(rank, *args)`` as a supervised, ELASTIC ``nproc``-process
+    gang.
 
     Like ``distributed.spawn`` but fault-tolerant: when any rank exits
     nonzero (killed, crashed, or watchdog-tripped) the surviving ranks are
@@ -191,6 +229,21 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
     times. ``fn`` is responsible for resuming from ``checkpoint_dir`` (via
     ``train(resume_from=...)``); the supervisor guarantees relaunch, fault
     disarming, the heartbeat side-channel, and failure diagnosis.
+
+    The gang SHRINKS instead of giving up when a rank is classified
+    permanently lost: its spawn itself failed (exit
+    ``SPAWN_FAIL_EXIT_CODE``), or the same rank has now failed more than
+    ``rank_restart_budget`` times at the current world size. The next
+    incarnation launches with one fewer process (ranks renumber to
+    ``0..n-2``; ``fn`` should derive its data slice from
+    ``jax.process_index()/process_count()`` after init) and resumes from
+    the newest valid checkpoint — sharded checkpoints re-partition their
+    score-cache shards onto the new world size on load (checkpoint.py).
+    Shrinks consume the same ``max_restarts`` budget as same-size
+    relaunches and are recorded in ``SupervisorReport.shrinks`` and the
+    ``supervisor_world_size`` health gauge. Shrinking requires
+    ``per_rank_args is None`` (a static per-rank payload pins the world
+    size) and stops at ``min_world_size``.
 
     Args:
       fn, nproc, args, per_rank_args, devices_per_proc: as in
@@ -202,6 +255,9 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
         fails within it counts as a failure (and is relaunched).
       diag_dir: where ranks' watchdog diagnoses land (default: a
         ``supervisor_diag`` dir inside checkpoint_dir, or a temp dir).
+      rank_restart_budget: same-rank failures tolerated at one world size
+        before the rank is declared permanently lost and the gang shrinks.
+      min_world_size: floor the gang may shrink to.
 
     Returns a SupervisorReport with rank 0's result and the restart
     history; raises GangFailedError after the budget is exhausted.
@@ -220,10 +276,14 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
             diag_dir = tempfile.mkdtemp(prefix="lgbm_supervisor_diag_")
     os.makedirs(diag_dir, exist_ok=True)
     failures: List[GangFailure] = []
+    shrinks: List[GangShrink] = []
+    world = int(nproc)
+    rank_failures: dict = {}          # rank -> failures at CURRENT world
     t0 = time.monotonic()
+    profiling.set_gauge("supervisor_world_size", world)
     for incarnation in range(max_restarts + 1):
         hb_port = distributed.free_port()
-        gang = _Incarnation(fn, nproc, args, per_rank_args,
+        gang = _Incarnation(fn, world, args, per_rank_args,
                             devices_per_proc, incarnation, hb_port,
                             diag_dir)
         profiling.set_gauge("supervisor_incarnation", incarnation)
@@ -232,7 +292,7 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
         dead_codes = {}
         deadline = None if timeout is None else time.monotonic() + timeout
         try:
-            while len(results) < nproc and failure is None:
+            while len(results) < world and failure is None:
                 try:
                     rank, ok, payload = gang.q.get(timeout=0.5)
                     if not ok:
@@ -254,12 +314,14 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
                     kinds = ", ".join(
                         f"rank {r} exit {c}"
                         + (" (watchdog)" if c ==
-                           distributed.WATCHDOG_EXIT_CODE else "")
+                           distributed.WATCHDOG_EXIT_CODE else
+                           (" (spawn failed)" if c ==
+                            distributed.SPAWN_FAIL_EXIT_CODE else ""))
                         for r, c in sorted(dead_codes.items()))
                     failure = f"gang member(s) died: {kinds}"
                     break
                 if deadline is not None and time.monotonic() > deadline:
-                    missing = [r for r in range(nproc) if r not in results]
+                    missing = [r for r in range(world) if r not in results]
                     failure = (f"incarnation timed out after {timeout}s "
                                f"waiting for ranks {missing}")
                     break
@@ -270,21 +332,69 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
             return SupervisorReport(result=results.get(0),
                                     restarts=incarnation,
                                     failures=failures,
-                                    wall_time=time.monotonic() - t0)
+                                    wall_time=time.monotonic() - t0,
+                                    world_size=world, shrinks=shrinks)
         diags = _read_diags(diag_dir)
         rec = GangFailure(
             incarnation=incarnation,
             failed_ranks=sorted(dead_codes) or
-            [r for r in range(nproc) if r not in results],
-            exit_codes=dead_codes, reason=failure, watchdog=diags)
+            [r for r in range(world) if r not in results],
+            exit_codes=dead_codes, reason=failure, watchdog=diags,
+            world_size=world)
         failures.append(rec)
         sus = {s for d in diags for s in (d.get("suspects") or [])}
+        # ---- permanent-loss classification -> gang shrink
+        hard = {r for r, c in rec.exit_codes.items()
+                if c in (137, distributed.SPAWN_FAIL_EXIT_CODE)}
+        for r in rec.failed_ranks:
+            if r not in rec.exit_codes:
+                # incarnation timeout: ranks merely missing from results
+                # carry no evidence of THEIR failure (a slow-but-healthy
+                # rank must not be classified permanently lost)
+                continue
+            if rec.exit_codes.get(r) == distributed.WATCHDOG_EXIT_CODE:
+                # a watchdog exit is the SYMPTOM of a stalled gang (this
+                # rank declared a peer dead/hung), not evidence the rank
+                # itself is bad — it must not burn its restart budget
+                continue
+            if hard and r not in hard:
+                # when some rank died HARD (kill/OOM 137, spawn failure)
+                # in the same incarnation, generic nonzero exits alongside
+                # it are likely collateral (e.g. coordination-service
+                # calls failing once the peer is gone) — charging them
+                # would mis-target the shrink at healthy ranks
+                continue
+            rank_failures[r] = rank_failures.get(r, 0) + 1
+        lost = sorted(set(rec.spawn_failed_ranks)
+                      | {r for r in rec.failed_ranks
+                         if rank_failures.get(r, 0)
+                         > max(0, int(rank_restart_budget))})
+        shrink = None
+        if lost and per_rank_args is None \
+                and world - len(lost) >= max(1, int(min_world_size)):
+            why = ", ".join(
+                f"rank {r} " + ("spawn failed"
+                                if r in rec.spawn_failed_ranks else
+                                f"failed {rank_failures[r]}x (budget "
+                                f"{rank_restart_budget})")
+                for r in lost)
+            shrink = GangShrink(incarnation=incarnation, from_nproc=world,
+                                to_nproc=world - len(lost),
+                                lost_ranks=lost, reason=why)
+            shrinks.append(shrink)
+            world -= len(lost)
+            rank_failures = {}        # new gang numbering: counts reset
+            profiling.set_gauge("supervisor_world_size", world)
+            profiling.set_gauge("supervisor_shrinks", len(shrinks))
         log.warning(
             f"supervisor: incarnation {incarnation} failed ({failure})"
             + (f"; watchdog suspects rank(s) "
                f"{sorted(sus)} at iteration "
                f"{max((d.get('iteration', -1) for d in diags), default=-1)}"
                if diags else "")
+            + (f"; rank(s) {shrink.lost_ranks} permanently lost "
+               f"({shrink.reason}) — SHRINKING gang "
+               f"{shrink.from_nproc} -> {shrink.to_nproc}" if shrink else "")
             + (f"; relaunching from {checkpoint_dir}"
                if incarnation < max_restarts and checkpoint_dir else
                ("; relaunching" if incarnation < max_restarts else "")))
@@ -332,7 +442,9 @@ def train_supervised(params: dict, data, label=None,
         args=(data, label, params, num_boost_round, checkpoint_dir,
               checkpoint_period, dict(train_kwargs)),
         devices_per_proc=devices_per_proc, checkpoint_dir=checkpoint_dir,
-        max_restarts=cfg_restarts, timeout=timeout)
+        max_restarts=cfg_restarts, timeout=timeout,
+        rank_restart_budget=int(params.get("rank_restart_budget", 1)),
+        min_world_size=int(params.get("min_world_size", 1)))
     from .booster import Booster
     return Booster(params=params, model_str=report.result), report
 

@@ -32,6 +32,23 @@ a child process without touching its config):
   LGBM_TPU_FAULT_CORRUPT_CHECKPOINT=1 flip bytes in every checkpoint's
                                       model text right after it is written
                                       (simulates on-disk corruption)
+  LGBM_TPU_FAULT_KILL_IN_SHARD_WRITE=r:k  hard-exit rank r between writing
+                                      its score-cache shard and the shard-
+                                      metadata exchange of the SHARDED
+                                      checkpoint write for iteration k
+                                      (pre-partitioned gangs; the stale
+                                      ckpt_N.tmp must stay harmless)
+  LGBM_TPU_FAULT_CORRUPT_SHARD=r      flip bytes in rank r's shard file of
+                                      every sharded checkpoint right after
+                                      publication (manifest stays intact,
+                                      so only checksum validation catches
+                                      it)
+  LGBM_TPU_FAULT_SPAWN_FAIL_RANK=r    make spawned child rank r exit with
+                                      SPAWN_FAIL_EXIT_CODE (96) before any
+                                      bootstrap — the "machine cannot
+                                      start" shape the supervisor answers
+                                      with a gang SHRINK (env-driven only:
+                                      it fires before a config exists)
 
 The rank-targeted forms resolve the process rank lazily through
 ``jax.process_index()`` so the plan can be built before distributed init.
@@ -57,6 +74,8 @@ class FaultPlan:
     kill_rank_at_iter: Optional[Tuple[int, int]] = None   # (rank, iter)
     hang_rank_at_iter: Optional[Tuple[int, int]] = None   # (rank, iter)
     kill_in_ckpt_write: int = -1
+    kill_in_shard_write: Optional[Tuple[int, int]] = None  # (rank, iter)
+    corrupt_shard: int = -1                               # rank
     nan_grad_at_iter: int = -1
     nan_grad_count: int = 8
     corrupt_checkpoint: bool = False
@@ -74,10 +93,12 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
-def _env_rank_iter(name: str) -> Optional[Tuple[int, int]]:
-    """Parse an "r:k" rank-targeted fault env var; None when unset or
-    malformed (a malformed value must not silently kill rank 0)."""
-    v = os.environ.get(name, "")
+def _env_rank_iter(name: str,
+                   default: str = "") -> Optional[Tuple[int, int]]:
+    """Parse an "r:k" rank-targeted fault env var (falling back to the
+    config-param twin's string value); None when unset or malformed (a
+    malformed value must not silently kill rank 0)."""
+    v = os.environ.get(name, "") or str(default or "")
     if not v:
         return None
     try:
@@ -99,10 +120,19 @@ def plan_from(config=None) -> Optional[FaultPlan]:
                               int(get("fault_kill_at_iter", -1))),
         hang_at_iter=_env_int("LGBM_TPU_FAULT_HANG_AT_ITER",
                               int(get("fault_hang_at_iter", -1))),
-        kill_rank_at_iter=_env_rank_iter("LGBM_TPU_FAULT_KILL_RANK_AT_ITER"),
-        hang_rank_at_iter=_env_rank_iter("LGBM_TPU_FAULT_HANG_RANK_AT_ITER"),
+        kill_rank_at_iter=_env_rank_iter(
+            "LGBM_TPU_FAULT_KILL_RANK_AT_ITER",
+            get("fault_kill_rank_at_iter", "")),
+        hang_rank_at_iter=_env_rank_iter(
+            "LGBM_TPU_FAULT_HANG_RANK_AT_ITER",
+            get("fault_hang_rank_at_iter", "")),
         kill_in_ckpt_write=_env_int("LGBM_TPU_FAULT_KILL_IN_CKPT_WRITE",
                                     int(get("fault_kill_in_ckpt_write", -1))),
+        kill_in_shard_write=_env_rank_iter(
+            "LGBM_TPU_FAULT_KILL_IN_SHARD_WRITE",
+            get("fault_kill_in_shard_write", "")),
+        corrupt_shard=_env_int("LGBM_TPU_FAULT_CORRUPT_SHARD",
+                               int(get("fault_corrupt_shard", -1))),
         nan_grad_at_iter=_env_int("LGBM_TPU_FAULT_NAN_GRAD_AT_ITER",
                                   int(get("fault_nan_grad_at_iter", -1))),
         nan_grad_count=_env_int("LGBM_TPU_FAULT_NAN_GRAD_COUNT", 8),
@@ -117,6 +147,8 @@ def plan_from(config=None) -> Optional[FaultPlan]:
             and plan.kill_rank_at_iter is None
             and plan.hang_rank_at_iter is None
             and plan.kill_in_ckpt_write < 0
+            and plan.kill_in_shard_write is None
+            and plan.corrupt_shard < 0
             and plan.nan_grad_at_iter < 0
             and not plan.corrupt_checkpoint):
         return None
@@ -219,3 +251,48 @@ def maybe_corrupt_checkpoint(plan: Optional[FaultPlan], path: str) -> None:
     so only checksum validation can catch it)."""
     if plan is not None and plan.corrupt_checkpoint:
         corrupt_file(path)
+
+
+def maybe_kill_in_shard_write(plan: Optional[FaultPlan],
+                              iteration: int) -> None:
+    """Kill rank r between writing its score-cache shard into the staging
+    directory and the shard-metadata exchange — mid-protocol death of ONE
+    participant in the sharded checkpoint write. The manifest never lands,
+    so the stale ``ckpt_N.tmp`` must be ignored by readers and reclaimed
+    by the next write."""
+    if plan is None or plan.kill_in_shard_write is None:
+        return
+    if plan.kill_in_shard_write[1] == iteration \
+            and plan.kill_in_shard_write[0] == _process_rank():
+        _hard_exit(f"(rank {plan.kill_in_shard_write[0]}) inside sharded "
+                   f"checkpoint write for iteration {iteration}")
+
+
+def maybe_corrupt_shard(plan: Optional[FaultPlan], path: str,
+                        rank: int) -> None:
+    """Corrupt ONE rank's published shard file (manifest intact): only the
+    per-shard sha256 in MANIFEST.json can catch it, and the checkpoint
+    must then be treated as invalid by the prune/fallback logic."""
+    if plan is not None and plan.corrupt_shard == rank:
+        corrupt_file(path)
+
+
+def maybe_fail_spawn(rank: int) -> None:
+    """Spawn-failure injection point, called at the very top of spawned
+    children (before jax/distributed bootstrap, so it is env-driven only):
+    exits with SPAWN_FAIL_EXIT_CODE so the supervisor classifies the rank
+    as permanently lost and shrinks the gang."""
+    v = os.environ.get("LGBM_TPU_FAULT_SPAWN_FAIL_RANK", "")
+    if not v:
+        return
+    try:
+        target = int(v)
+    except ValueError:
+        sys.stderr.write(f"[faults] ignoring malformed "
+                         f"LGBM_TPU_FAULT_SPAWN_FAIL_RANK={v!r}\n")
+        return
+    if target == rank:
+        from .. import distributed
+        sys.stderr.write(f"[faults] failing spawn of rank {rank}\n")
+        sys.stderr.flush()
+        os._exit(distributed.SPAWN_FAIL_EXIT_CODE)

@@ -1,16 +1,21 @@
 #!/usr/bin/env python
-"""Supervisor gang-restart smoke: fast knobs, ~30 s on CPU.
+"""Supervisor gang-restart + elastic-shrink smoke: fast knobs, ~45 s on CPU.
 
-Launches a 2-process localhost gang training with per-iteration
-checkpoints, hard-kills rank 1 at iteration 3 (os._exit 137 via the fault
-harness), and asserts the supervisor relaunches the gang exactly once and
-the final model text is BIT-IDENTICAL to an uninterrupted gang's — the
-acceptance loop of the training-supervision layer
-(lightgbm_tpu/supervisor.py + the heartbeat/watchdog in distributed.py).
+Two stanzas:
+  1. restart — a 2-process localhost gang training with per-iteration
+     checkpoints has rank 1 hard-killed at iteration 3 (os._exit 137 via
+     the fault harness); the supervisor must relaunch the gang exactly
+     once and the final model text must be BIT-IDENTICAL to an
+     uninterrupted gang's.
+  2. elastic — rank 1's spawn fails outright (exit 96 via
+     LGBM_TPU_FAULT_SPAWN_FAIL_RANK); the supervisor must classify the
+     rank permanently lost, SHRINK the gang to world size 1, complete
+     training there, and record the shrink in the SupervisorReport.
 
 Usage:  JAX_PLATFORMS=cpu python scripts/supervisor_smoke.py
-Exits 0 on success, 1 with a diagnosis otherwise. The same path runs in
-tier-1 as tests/test_supervisor.py::test_gang_kill_rank_mid_iter_bit_identical.
+Exits 0 on success, 1 with a diagnosis otherwise. The same paths run in
+tier-1 as tests/test_supervisor.py::test_gang_kill_rank_mid_iter_bit_identical
+and ::test_gang_shrink_on_spawn_fail.
 """
 import os
 import sys
@@ -65,8 +70,28 @@ def main() -> int:
             print("FAIL: restarted gang's model text differs from the "
                   "uninterrupted run's")
             return 1
+        # ---- elastic stanza: rank 1 permanently lost -> gang shrinks
+        cke = os.path.join(td, "ck_elastic")
+        os.environ["LGBM_TPU_FAULT_SPAWN_FAIL_RANK"] = "1"
+        try:
+            elastic = supervisor.run_supervised(
+                train_fn, nproc=2, args=(cke,), devices_per_proc=1,
+                checkpoint_dir=cke, max_restarts=2, timeout=180)
+        finally:
+            os.environ.pop("LGBM_TPU_FAULT_SPAWN_FAIL_RANK", None)
+        if elastic.world_size != 1 or len(elastic.shrinks) != 1 \
+                or elastic.shrinks[0].lost_ranks != [1]:
+            print(f"FAIL: expected one 2->1 shrink of lost rank 1, got "
+                  f"world_size={elastic.world_size} "
+                  f"shrinks={elastic.shrinks}")
+            return 1
+        if elastic.result != clean.result:
+            print("FAIL: shrunken gang's model text differs from the "
+                  "uninterrupted run's")
+            return 1
     print(f"OK: gang killed at iter 3, restarted once, model text "
-          f"bit-identical ({time.time() - t0:.1f}s)")
+          f"bit-identical; spawn-failed rank 1 shrank the gang 2->1 and "
+          f"training completed ({time.time() - t0:.1f}s)")
     return 0
 
 

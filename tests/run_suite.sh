@@ -8,8 +8,10 @@ for f in "$(dirname "$0")"/test_*.py; do
   echo "=== $f"
   python -u -m pytest "$f" -q --no-header || fail=1
 done
-# supervisor gang-restart smoke (fast knobs, ~30 s): kill a rank mid-iter,
-# relaunch from checkpoint, assert bit-identical final model
+# supervisor gang-restart + elastic smoke (fast knobs, ~45 s): kill a rank
+# mid-iter -> relaunch from checkpoint -> bit-identical final model, then
+# fail a rank's spawn permanently -> gang shrinks to world size 1 and
+# completes (the shrink recorded in the SupervisorReport)
 echo "=== scripts/supervisor_smoke.py"
 python -u "$(dirname "$0")/../scripts/supervisor_smoke.py" || fail=1
 exit $fail

@@ -75,7 +75,11 @@ def test_deep_tree_shap_no_recursion_error():
                                atol=1e-6 * np.abs(raw).max())
 
 
+@pytest.mark.slow
 def test_pandas_categorical_continued_training_mismatch():
+    """(Slow tier: an error-path spelling — the pandas_categorical
+    code-mapping contract itself stays tier-1 via the pandas-categorical
+    tests in test_categorical.py.)"""
     pd = pytest.importorskip("pandas")
     rng = np.random.RandomState(1)
     n = 400
@@ -229,9 +233,14 @@ def test_reset_config_revalidates_tree_learner():
              "verbosity": -1}))
 
 
+@pytest.mark.slow
 def test_sparse_predict_with_loaded_init_model():
     """Continued-training boosters (loaded init model) must densify sparse
-    predict input before walking the loaded host trees."""
+    predict input before walking the loaded host trees. (Slow tier: the
+    init_model × sparse COMBINATION cell — sparse column reconstruction
+    for prediction stays tier-1 via test_sparse_valid_against_dense_
+    reference and test_eval_on_sparse_stored_train; init_model
+    continuation via test_fault_tolerance.py's parity test.)"""
     import scipy.sparse as sp
     rng = np.random.RandomState(7)
     n, f = 500, 5

@@ -58,9 +58,15 @@ def test_rf_requires_bagging():
                   ds, num_boost_round=2)
 
 
+@pytest.mark.slow
 def test_dart_normalization_scales_trees():
     """After a drop, the dropped trees' stored values must have been scaled
-    by k/(k+1) — total |leaf values| shrinks vs never-dropped GBDT."""
+    by k/(k+1) — total |leaf values| shrinks vs never-dropped GBDT.
+    (Slow tier: DART's normalization arithmetic is pinned tier-1 by the
+    dart kill-resume MODEL-TEXT bit-parity in test_fault_tolerance.py —
+    any normalization drift changes the text — plus
+    test_mode_learns_binary[dart]; the per-tree scaling inspection alone
+    rides here.)"""
     X, y = _binary_problem(n=400)
     base = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.3,
             "min_data_in_leaf": 5, "verbosity": -1}
@@ -123,8 +129,13 @@ def test_goss_weights_exact_counts_under_ties():
     assert np.all(w2[:500] == 1.0)
 
 
+@pytest.mark.slow
 def test_dart_vs_gbdt_with_skip_drop_one():
-    """skip_drop=1.0 means never drop: DART must match plain GBDT exactly."""
+    """skip_drop=1.0 means never drop: DART must match plain GBDT exactly.
+    (Slow tier: a degenerate-corner equivalence — DART's live coverage
+    stays tier-1 via test_mode_learns_binary[dart], the normalization
+    test above, and the dart kill-resume bit-parity in
+    test_fault_tolerance.py.)"""
     X, y = _binary_problem(n=300)
     base = {"objective": "binary", "num_leaves": 7, "learning_rate": 0.2,
             "min_data_in_leaf": 5, "verbosity": -1}

@@ -179,10 +179,13 @@ def test_bundled_model_text_roundtrip(tmp_path):
                                rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_allstate_shaped_constructs_and_trains():
     """A wide-sparse synthetic (VERDICT: 'Allstate-shaped ... constructs
     within memory, bundles to O(100) effective columns, trains'). Scaled to
-    test-size (the full 13.2Mx4228 is the benchmark's job)."""
+    test-size (the full 13.2Mx4228 is the benchmark's job). (Slow tier: a
+    shape/scale smoke — EFB correctness stays tier-1 via the
+    bundled-vs-unbundled parity tests in this file.)"""
     rng = np.random.RandomState(5)
     n, f = 60_000, 2000
     X = _onehotish(rng, n, f, density=0.001)   # ~99.9% sparse

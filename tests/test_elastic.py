@@ -1,0 +1,450 @@
+"""Elastic-gang checkpoint suite: sharded score-cache checkpoints for
+pre-partitioned training, resume at a DIFFERENT world size via
+re-partition-on-load, and the hardened shard manifests.
+
+The acceptance bar: pre-partitioned kill-at-k + resume at the SAME world
+size is bit-identical to the uninterrupted run (gbdt + bagging configs),
+and resume from a checkpoint written under a DIFFERENT world size starts
+from the exact same per-row score state — re-partitioning is pure row
+movement — so the continuation here (same device count) is also
+bit-identical, with tree structure exactly equal. On real multi-host
+meshes a different world size changes the f32 histogram partial-sum
+ORDER, which bounds leaf values at the documented eps(leaf_total) level
+while tree structure stays equal (see README "Elastic gangs" and the PR 3
+dryrun_multichip certificate for the same numerics statement).
+
+Everything runs replicated-serial/coordination-service style: this
+container's CPU backend cannot run cross-process XLA collectives, so the
+multi-rank spellings fabricate partitions with
+``checkpoint.repartition_checkpoint`` (4-way and 3-way shard layouts, the
+4->2->3 matrix at the protocol level) and the true 2-process protocol
+test (coordination-service KV exchange, no XLA) rides the slow tier.
+"""
+
+import json
+import os
+import pickle
+import shutil
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import checkpoint as ckpt_mod
+from lightgbm_tpu import distributed
+from lightgbm_tpu.checkpoint import CheckpointManager
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.log import LightGBMError
+
+pytestmark = pytest.mark.faults
+
+N, F = 320, 6
+ROUNDS, K = 5, 3     # K is MID bagging period (freq 2): the resume must
+                     # re-derive the period-start mask, not just load state
+
+BAG_PARAMS = {"objective": "binary", "num_leaves": 8, "min_data_in_leaf": 5,
+              "boost_from_average": False, "histogram_method": "scatter",
+              "verbosity": -1, "tree_learner": "data",
+              "bagging_fraction": 0.7, "bagging_freq": 2, "bagging_seed": 5}
+GBDT_PARAMS = {k: v for k, v in BAG_PARAMS.items()
+               if not k.startswith("bagging")}
+
+
+def _data():
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(N, F))
+    y = (X[:, 0] - 0.5 * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+def _train(params, rounds, ckdir=None, resume=None, keep=4):
+    X, y = _data()
+    ds = distributed.load_partitioned(X, label=y, params=dict(params))
+    cbs = ([lgb.checkpoint_callback(ckdir, period=1, keep=keep)]
+           if ckdir else [])
+    return lgb.train(dict(params), ds, rounds, callbacks=cbs,
+                     resume_from=resume)
+
+
+@pytest.fixture(scope="module")
+def bag_run(tmp_path_factory):
+    """One bagging-config training pair shared by the file: the
+    uninterrupted 6-round model text and a checkpoint directory holding
+    the first K=3 iterations (per-iteration sharded checkpoints)."""
+    td = tmp_path_factory.mktemp("elastic_bag")
+    full = _train(BAG_PARAMS, ROUNDS).model_to_string()
+    ckdir = str(td / "ck")
+    _train(BAG_PARAMS, K, ckdir=ckdir)
+    return {"full": full, "ckdir": ckdir, "td": td}
+
+
+def _fresh_copy(bag_run, name):
+    """Private copy of the shared checkpoint dir for mutating tests."""
+    dst = str(bag_run["td"] / name)
+    shutil.copytree(bag_run["ckdir"], dst)
+    return dst
+
+
+# ============================================== sharded layout + manifest
+def test_sharded_layout_and_hardened_manifest(bag_run):
+    """A pre-partitioned checkpoint is SHARDED: shard_rank0.pkl +
+    PARTITION.json exist, MANIFEST.json lists every shard with
+    bytes+sha256, and the dataset fingerprint is per-rank."""
+    lc = CheckpointManager(bag_run["ckdir"]).load_latest_valid()
+    assert lc is not None and lc.iteration == K
+    files = sorted(os.listdir(lc.path))
+    assert "shard_rank0.pkl" in files
+    assert "PARTITION.json" in files
+    man = lc.manifest
+    assert man["world_size"] == 1
+    shard = man["files"]["shard_rank0.pkl"]
+    assert shard["bytes"] == os.path.getsize(
+        os.path.join(lc.path, "shard_rank0.pkl"))
+    assert len(shard["sha256"]) == 64
+    assert isinstance(man["dataset_fingerprint"], dict)
+    assert set(man["dataset_fingerprint"]) == {"0"}
+    part = lc.partition
+    assert part["global_rows"] == N
+    assert [(e["row_start"], e["row_count"]) for e in part["ranks"]] \
+        == [(0, N)]
+    assert len(part["ranks"][0]["label_sha256"]) == 64
+    # the global state.pkl holds no score caches (they live in the shard)
+    assert "train_score" not in lc.state["boosting"]
+    with open(os.path.join(lc.path, "shard_rank0.pkl"), "rb") as fh:
+        local = pickle.load(fh)
+    assert local["train_score"].shape[0] == N
+
+
+@pytest.mark.parametrize("params", [
+    # the plain-gbdt cell rides the slow tier: the bagging cell below is
+    # a strict superset of its resume mechanics (same sharded write/read/
+    # reassembly paths, PLUS the mid-period mask re-derivation) and stays
+    # tier-1 off the shared fixture
+    pytest.param(GBDT_PARAMS, marks=pytest.mark.slow, id="gbdt"),
+    pytest.param(BAG_PARAMS, id="bagging")])
+def test_prepart_kill_resume_same_world_bit_identical(params, tmp_path,
+                                                      bag_run):
+    """THE acceptance bar, same world size: pre-partitioned training
+    interrupted at k=3 resumes to a model text byte-identical to the
+    uninterrupted run (k is mid bagging period for the bagging config, so
+    the partition-invariant mask re-derivation is on the line too)."""
+    if params is BAG_PARAMS:
+        full, ckdir = bag_run["full"], _fresh_copy(bag_run, "same_world")
+    else:
+        full = _train(params, ROUNDS).model_to_string()
+        ckdir = str(tmp_path / "ck")
+        _train(params, K, ckdir=ckdir)
+    resumed = _train(params, ROUNDS, ckdir=ckdir, resume=ckdir)
+    assert resumed.model_to_string() == full
+    assert resumed.current_iteration() == ROUNDS
+
+
+def test_resume_from_repartitioned_checkpoints_bit_identical(bag_run):
+    """Resume at a DIFFERENT world size: the iteration-3 checkpoint is
+    re-sharded offline to world sizes 4, then 4->2, then 2->3
+    (repartition_checkpoint — pure row movement), and each layout resumes
+    through the re-partition-on-load path to the SAME final model text as
+    the uninterrupted run: the reassembled score caches are bit-identical
+    per row, and on this fixed device count the continuation is too (tree
+    structure AND values; on real multi-host meshes the f32 partial-sum
+    order bounds values instead — see module docstring)."""
+    src = os.path.join(bag_run["ckdir"], f"ckpt_{K:08d}")
+    td = bag_run["td"]
+    p4 = ckpt_mod.repartition_checkpoint(src, 4, str(td / "w4"))
+    p2 = ckpt_mod.repartition_checkpoint(p4, 2, str(td / "w2"))
+    p3 = ckpt_mod.repartition_checkpoint(p2, 3, str(td / "w3"))
+    for path, world in ((p4, 4), (p2, 2), (p3, 3)):
+        with open(os.path.join(path, "PARTITION.json")) as fh:
+            part = json.load(fh)
+        assert part["world_size"] == world
+        counts = [e["row_count"] for e in part["ranks"]]
+        assert sum(counts) == N and max(counts) - min(counts) <= 1
+        resumed = _train(BAG_PARAMS, ROUNDS,
+                         ckdir=str(td / f"cont{world}"),
+                         resume=os.path.dirname(path))
+        assert resumed.model_to_string() == bag_run["full"], \
+            f"resume from world-{world} shards diverged"
+
+
+def test_repartition_preserves_row_bits(bag_run):
+    """Re-sharding 1 -> 4 slices the score cache without touching a bit:
+    concatenating the 4 shards reproduces the original rows exactly, and
+    exact-range metadata (label hash) carries over only where honest."""
+    src = os.path.join(bag_run["ckdir"], f"ckpt_{K:08d}")
+    with open(os.path.join(src, "shard_rank0.pkl"), "rb") as fh:
+        orig = pickle.load(fh)["train_score"]
+    p4 = ckpt_mod.repartition_checkpoint(src, 4, str(bag_run["td"] / "bits4"))
+    parts = []
+    for r in range(4):
+        with open(os.path.join(p4, f"shard_rank{r}.pkl"), "rb") as fh:
+            parts.append(pickle.load(fh)["train_score"])
+    np.testing.assert_array_equal(np.concatenate(parts), np.asarray(orig))
+    with open(os.path.join(p4, "PARTITION.json")) as fh:
+        part = json.load(fh)
+    # no new range maps exactly onto the old single-rank range, so no
+    # label hash may be carried over (it cannot be recomputed offline)
+    assert all(e["label_sha256"] is None for e in part["ranks"])
+    # and the re-sharded checkpoint validates in full
+    CheckpointManager(os.path.dirname(p4)).validate(p4)
+
+
+# ===================================================== repartition_rows
+def test_repartition_rows_matrix():
+    """The pure reassembly kernel: 4->2 and 2->3 over a known global
+    array return exact slices, touching only overlapping shards."""
+    g = np.arange(100, dtype=np.float32) * 2.0
+    for old_counts, new_counts in ([(25, 25, 25, 25), (50, 50)],
+                                   [(50, 50), (34, 33, 33)],
+                                   [(25, 25, 25, 25), (34, 33, 33)]):
+        old = []
+        s = 0
+        for c in old_counts:
+            old.append((s, c))
+            s += c
+        touched = set()
+
+        def fetch(r):
+            touched.add(r)
+            s0, c0 = old[r]
+            return g[s0:s0 + c0]
+
+        s = 0
+        for c in new_counts:
+            out = distributed.repartition_rows(old, s, c, fetch)
+            np.testing.assert_array_equal(out, g[s:s + c])
+            s += c
+        assert touched == set(range(len(old_counts)))
+
+
+def test_repartition_rows_rejects_gaps_and_short_shards():
+    old = [(0, 50), (60, 40)]                      # gap at [50, 60)
+    with pytest.raises(ValueError, match="gap at row 50"):
+        distributed.repartition_rows(
+            old, 0, 100, lambda r: np.zeros(old[r][1], np.float32))
+    old2 = [(0, 50), (50, 50)]
+    with pytest.raises(ValueError, match="has 10 rows"):
+        distributed.repartition_rows(
+            old2, 0, 100, lambda r: np.zeros(10, np.float32))
+
+
+def test_exchange_host_single_process():
+    assert distributed.exchange_host("t", "payload") == ["payload"]
+
+
+# ================================== manifest hardening: invalid fallback
+def test_missing_shard_invalidates_checkpoint(bag_run):
+    """A checkpoint missing a shard file fails validation and the
+    prune/fallback logic treats it exactly like a truncated one: the
+    previous valid checkpoint is resumed from instead."""
+    ckdir = _fresh_copy(bag_run, "missing_shard")
+    newest = os.path.join(ckdir, f"ckpt_{K:08d}")
+    os.unlink(os.path.join(newest, "shard_rank0.pkl"))
+    mgr = CheckpointManager(ckdir)
+    with pytest.raises(ValueError, match="missing file shard_rank0.pkl"):
+        mgr.validate(newest)
+    assert not mgr._quick_valid(newest)
+    lc = mgr.load_latest_valid()
+    assert lc is not None and lc.iteration == K - 1
+
+
+def test_corrupt_shard_checksum_invalidates_checkpoint(bag_run):
+    """Flipped bytes inside a shard (manifest intact) are caught by the
+    per-shard sha256 and the checkpoint falls back."""
+    ckdir = _fresh_copy(bag_run, "corrupt_shard")
+    newest = os.path.join(ckdir, f"ckpt_{K:08d}")
+    faults.corrupt_file(os.path.join(newest, "shard_rank0.pkl"))
+    mgr = CheckpointManager(ckdir)
+    with pytest.raises(ValueError, match="shard_rank0.pkl checksum"):
+        mgr.validate(newest)
+    assert mgr.load_latest_valid().iteration == K - 1
+    # byte-length damage (truncation) is caught even by the cheap
+    # structural check pruning uses
+    faults.corrupt_file(os.path.join(newest, "shard_rank0.pkl"),
+                        truncate=True)
+    assert not mgr._quick_valid(newest)
+
+
+def test_corrupt_shard_fault_injection_point(tmp_path):
+    """The fault_corrupt_shard injection point flips bytes in the TARGET
+    rank's shard right after publication (manifest intact), and the
+    damaged checkpoint fails validation — driven through the
+    rank-symmetric writer directly (the train-level fallback-to-scratch
+    behavior this produces is tier-1-covered by the corrupt-latest tests
+    in test_fault_tolerance.py)."""
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"fault_corrupt_shard": 0})
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2, config=cfg)
+    path = mgr.write_sharded(
+        1, model_text="m\n",
+        global_state={"boosting": {"iter": 1}, "callbacks": {}},
+        local_state={"train_score": np.zeros(8, np.float32),
+                     "valid_scores": []},
+        row_start=0, row_count=8, global_rows=8, fingerprint="fp",
+        label_sha256=None, valid_counts=[], phash="p")
+    with pytest.raises(ValueError, match="shard_rank0.pkl checksum"):
+        mgr.validate(path)
+    assert CheckpointManager(str(tmp_path / "ck")).load_latest_valid() \
+        is None
+
+
+def test_partition_label_tamper_rejected(bag_run):
+    """Row-content hardening: a label hash recorded in PARTITION.json that
+    no longer matches the dataset's rows must reject the resume (the
+    dataset changed or rows were reordered since the checkpoint)."""
+    ckdir = _fresh_copy(bag_run, "tamper")
+    newest = os.path.join(ckdir, f"ckpt_{K:08d}")
+    ppath = os.path.join(newest, "PARTITION.json")
+    with open(ppath) as fh:
+        part = json.load(fh)
+    part["ranks"][0]["label_sha256"] = "0" * 64
+    part_bytes = json.dumps(part, indent=1, sort_keys=True).encode()
+    with open(ppath, "wb") as fh:
+        fh.write(part_bytes)
+    # keep the manifest consistent so only the CONTENT check can fire
+    mpath = os.path.join(newest, "MANIFEST.json")
+    with open(mpath) as fh:
+        man = json.load(fh)
+    import hashlib
+    man["files"]["PARTITION.json"] = {
+        "bytes": len(part_bytes),
+        "sha256": hashlib.sha256(part_bytes).hexdigest()}
+    # drop the exact-range fingerprint so the content hash does the work
+    man["dataset_fingerprint"] = {}
+    with open(mpath, "w") as fh:
+        json.dump(man, fh, indent=1, sort_keys=True)
+    with pytest.raises(LightGBMError, match="recorded content hash"):
+        _train(BAG_PARAMS, ROUNDS, resume=ckdir)
+
+
+def test_sharding_toggle_off_writes_legacy_layout(tmp_path):
+    """checkpoint_shards=false keeps the replicated rank-0-only layout
+    for pre-partitioned datasets: no shard files, score caches inside
+    state.pkl — and a world-1 booster restores from it (resume at the
+    checkpointed iteration; the full bit-parity continuation of the
+    legacy layout is PR 2's tier-1 coverage)."""
+    params = dict(GBDT_PARAMS, checkpoint_shards=False)
+    ckdir = str(tmp_path / "ck")
+    _train(params, K, ckdir=ckdir)
+    lc = CheckpointManager(ckdir).load_latest_valid()
+    assert lc.partition is None
+    assert "shard_rank0.pkl" not in os.listdir(lc.path)
+    assert "train_score" in lc.state["boosting"]
+    restored = _train(params, K, resume=ckdir)     # start_iter==K: restore
+    assert restored.current_iteration() == K       # only, no new rounds
+    assert restored.model_to_string().split("\nparameters:")[0] == \
+        lc.model_text.split("\nparameters:")[0]
+
+
+def test_replicated_booster_resumes_from_sharded_checkpoint(bag_run,
+                                                            tmp_path):
+    """The sharded layout is readable by a NON-pre-partitioned booster
+    too (row_start 0, all rows): replicated training resumes from a
+    sharded checkpoint through the same reassembly path."""
+    X, y = _data()
+    full_ds = lgb.Dataset(X, label=y, params=dict(BAG_PARAMS),
+                          free_raw_data=False)
+    # NOTE: replicated bagging draws differ from the pre-partitioned
+    # per-global-row draw, so continue only ONE iteration inside the same
+    # bagging period (period of iter 3 was drawn at iter 2 and is
+    # re-derived per-mode; structure check keeps this honest)
+    ckdir = _fresh_copy(bag_run, "replicated_read")
+    booster = lgb.train(dict(BAG_PARAMS), full_ds, K, resume_from=ckdir)
+    assert booster.current_iteration() == K
+    # the restored trees are the checkpoint's trees, byte for byte
+    lc = CheckpointManager(ckdir).load_latest_valid()
+    assert booster.model_to_string().split("\nparameters:")[0] == \
+        lc.model_text.split("\nparameters:")[0]
+
+
+# ============================= kill-during-shard-write (stale .tmp path)
+def test_stale_sharded_tmp_ignored_and_reclaimed(bag_run):
+    """A writer killed mid-shard-write leaves ckpt_N.tmp with shard files
+    but no manifest: readers ignore it, the next save reclaims it (the
+    fast sibling of the slow subprocess kill test below)."""
+    ckdir = _fresh_copy(bag_run, "stale_tmp")
+    stale = os.path.join(ckdir, f"ckpt_{K + 1:08d}.tmp")
+    os.makedirs(stale)
+    with open(os.path.join(stale, "shard_rank0.pkl"), "wb") as fh:
+        fh.write(b"partial shard bytes")
+    mgr = CheckpointManager(ckdir)
+    assert mgr.load_latest_valid().iteration == K    # .tmp invisible
+    resumed = _train(BAG_PARAMS, ROUNDS, ckdir=ckdir, resume=ckdir)
+    assert resumed.model_to_string() == bag_run["full"]
+    assert not [e for e in os.listdir(ckdir) if e.endswith(".tmp")]
+
+
+@pytest.mark.slow
+def test_kill_in_shard_write_subprocess_recovers(tmp_path):
+    """Real os._exit(137) between the shard write and the metadata
+    exchange (LGBM_TPU_FAULT_KILL_IN_SHARD_WRITE): the stale .tmp is
+    harmless and a respawned run resumes from the previous checkpoint to
+    the uninterrupted model. (Tier-1 sibling:
+    test_stale_sharded_tmp_ignored_and_reclaimed.)"""
+    import subprocess
+    import sys
+    ckdir = str(tmp_path / "ck")
+    code = f"""
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.argv = ["x"]
+import test_elastic as te
+te._train(te.BAG_PARAMS, te.ROUNDS, ckdir={ckdir!r})
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LGBM_TPU_FAULT_KILL_IN_SHARD_WRITE="0:2",
+               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 137, proc.stderr[-2000:]
+    assert [e for e in os.listdir(ckdir) if e.endswith(".tmp")]
+    full = _train(BAG_PARAMS, ROUNDS).model_to_string()
+    resumed = _train(BAG_PARAMS, ROUNDS, ckdir=ckdir, resume=ckdir)
+    assert resumed.model_to_string() == full
+    assert not [e for e in os.listdir(ckdir) if e.endswith(".tmp")]
+
+
+# ========================== true multi-process protocol (slow: 2 ranks)
+def _proto_fn(rank, ckdir):
+    """2-rank sharded write + re-partitioned read driven ONLY by the
+    coordination service (no cross-process XLA — the swappable collective
+    floor): fabricated per-rank states, real exchange/staging/rename."""
+    from lightgbm_tpu import checkpoint as ck
+    from lightgbm_tpu import distributed as dist
+    counts = [100, 150]
+    start, n = sum(counts[:rank]), counts[rank]
+    score = np.arange(start, start + n, dtype=np.float32) * 0.5
+    mgr = ck.CheckpointManager(ckdir, keep=2)
+    mgr.write_sharded(
+        7, model_text="protocol test\n",
+        global_state={"boosting": {"iter": 7}, "callbacks": {}},
+        local_state={"train_score": score, "valid_scores": []},
+        row_start=start, row_count=n, global_rows=250,
+        fingerprint=f"fp{rank}", label_sha256=None, valid_counts=[],
+        phash="abc")
+    dist.barrier("proto_after_write")
+    lc = ck.CheckpointManager(ckdir).load_latest_valid()
+    assert lc.partition["world_size"] == 2
+    # re-partition onto a different split: [0,200) / [200,250)
+    new_counts = [200, 50]
+    ns, nn = sum(new_counts[:rank]), new_counts[rank]
+    local = ck.reassemble_local_state(lc, ns, nn, [])
+    np.testing.assert_array_equal(
+        local["train_score"],
+        np.arange(ns, ns + nn, dtype=np.float32) * 0.5)
+    return sorted(os.listdir(lc.path))
+
+
+@pytest.mark.slow
+def test_two_process_sharded_protocol(tmp_path):
+    """Every cross-rank step of the sharded checkpoint protocol — stage
+    decision broadcast, per-rank shard writes, metadata exchange, rank-0
+    manifest + rename, re-partitioned read — in a REAL 2-process gang
+    over the coordination service. (Tier-1 siblings: the world-1 layout
+    test + the reassembly matrix above exercise the same code paths
+    single-process.)"""
+    files = distributed.spawn(_proto_fn, nproc=2,
+                              args=(str(tmp_path / "ck"),),
+                              devices_per_proc=1, timeout=240)
+    assert "shard_rank0.pkl" in files and "shard_rank1.pkl" in files
+    assert "PARTITION.json" in files

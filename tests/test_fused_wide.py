@@ -111,7 +111,11 @@ def test_fused_parity_forced_splits(data, tmp_path):
 @pytest.mark.parametrize("mode,extra", [
     ("data", {}),
     ("feature", {}),
-    ("voting", {"top_k": 3}),
+    # the voting cell rides the slow tier: the fused embedding it shares
+    # with data/feature stays tier-1 above, and voting-specific behavior
+    # is pinned tier-1 by the mesh-8 voting collective-volume regression
+    # below (plus the full voting matrix in test_distributed.py, slow)
+    pytest.param("voting", {"top_k": 3}, marks=pytest.mark.slow),
 ])
 def test_fused_parity_parallel(data, mode, extra):
     """The parallel learners' fused step embeds the SAME shard_map'd

@@ -63,7 +63,12 @@ def test_binary_matches_sklearn_quality(binary_example):
     assert ours > theirs - 0.01
 
 
+@pytest.mark.slow
 def test_multiclass(rng):
+    """(Slow tier: multiclass training runs tier-1 inside
+    test_fused_wide.py::test_fused_parity_multiclass — which trains the
+    SAME unfused program this test uses and asserts fused parity against
+    it; the learning-quality claim alone rides here.)"""
     n, k = 1500, 4
     X = rng.normal(size=(n, 8))
     logits = X[:, :k] * 2.0

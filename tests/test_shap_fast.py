@@ -71,7 +71,12 @@ def test_fast_shap_nan_and_categorical():
     _assert_fast_matches_reference(_model_trees(b), X[:300], F)
 
 
+@pytest.mark.slow
 def test_fast_shap_multiclass_layout():
+    """(Slow tier: the [N, K*(F+1)] multiclass contrib LAYOUT cell — the
+    fast-SHAP values themselves stay tier-1 via the binary/regression
+    parity tests in this file, and multiclass predict layout via
+    test_predict_engine.py.)"""
     rng = np.random.RandomState(3)
     n, F, K = 900, 4, 3
     X = rng.normal(size=(n, F))

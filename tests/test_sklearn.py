@@ -11,7 +11,11 @@ from sklearn.datasets import make_classification, make_regression  # noqa: E402
 from sklearn.metrics import r2_score, roc_auc_score  # noqa: E402
 
 
+@pytest.mark.slow
 def test_classifier_binary():
+    """(Slow tier: the string-label classifier test below is a strict
+    superset of this cell's wrapper plumbing — fit/predict/accuracy on a
+    binary problem — and stays tier-1.)"""
     X, y = make_classification(n_samples=600, n_features=8, random_state=0)
     clf = LGBMClassifier(n_estimators=15, num_leaves=15, min_child_samples=5)
     clf.fit(X, y)
@@ -34,7 +38,12 @@ def test_classifier_string_labels():
     assert (preds == ys).mean() > 0.9
 
 
+@pytest.mark.slow
 def test_classifier_multiclass():
+    """(Slow tier: the sklearn WRAPPER surface stays tier-1 via the
+    string-label classifier test, and multiclass training itself via the
+    fused multiclass parity in test_fused_wide.py — this cell only
+    combines the two.)"""
     X, y = make_classification(n_samples=900, n_features=8, n_informative=6,
                                n_classes=3, random_state=2)
     clf = LGBMClassifier(n_estimators=10, min_child_samples=5).fit(X, y)
@@ -89,7 +98,11 @@ def test_clone_and_get_params():
     assert cloned.get_params()["cat_smooth"] == 5.0
 
 
+@pytest.mark.slow
 def test_custom_objective_callable():
+    """(Slow tier: the fobj training path stays tier-1 via engine-level
+    custom-objective coverage — e.g. test_fault_tolerance.py's fobj
+    numerics guard — this spelling only adds the sklearn plumbing.)"""
     X, y = make_regression(n_samples=400, n_features=5, random_state=5)
 
     def l2_obj(y_true, y_pred):

@@ -107,7 +107,12 @@ def test_sparse_reconstruction_and_histogram_exactness(rng):
                                    rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_sparse_end_to_end_quality_parity(rng):
+    """(Slow tier: a quality-parity spelling — the sparse-vs-dense
+    MECHANICS stay tier-1 via test_sparse_all_columns_sparse,
+    test_sparse_reconstruction_and_histogram_exactness and the sparse
+    eval/predict regressions in test_advisor_fixes.py.)"""
     X, y = _sparse_frame(rng)
     ds_d, b_dense = _fit(X, y, enable_sparse=False)
     ds_s, b_sparse = _fit(X, y, enable_sparse=True)
@@ -126,7 +131,13 @@ def test_sparse_end_to_end_quality_parity(rng):
                                rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_sparse_parity_with_bagging_and_categorical(rng):
+    """(Slow tier: the bagging×categorical×sparse COMBINATION cell —
+    sparse training/eval mechanics stay tier-1 via
+    test_sparse_all_columns_sparse and the sparse eval/predict
+    regressions in test_advisor_fixes.py; bagging and categorical parity
+    each have their own tier-1 files.)"""
     X, y = _sparse_frame(rng, sparse_f=2)
     # a concentrated CATEGORICAL column (mode category >= 90%)
     cat = np.where(rng.uniform(size=len(X)) < 0.93, 0.0,

@@ -46,7 +46,13 @@ def _data():
 GANG_PARAMS = {"objective": "binary", "num_leaves": 8,
                "min_data_in_leaf": 5, "boost_from_average": False,
                "histogram_method": "scatter", "verbosity": -1,
-               "heartbeat_interval": 0.4, "collective_deadline": 5.0}
+               # the deadline is judged at every checkpoint barrier: on
+               # this loaded 1-core container a 5 s deadline occasionally
+               # fired on a HEALTHY slow peer mid-suite, burning a
+               # spurious incarnation (restarts==2 flake) — 12 s still
+               # detects the hang-rank case in seconds, far under the
+               # test timeouts
+               "heartbeat_interval": 0.4, "collective_deadline": 12.0}
 GANG_ROUNDS = 4
 
 
@@ -216,6 +222,120 @@ def test_supervisor_gives_up_after_max_restarts(tmp_path):
     assert all(137 in f.exit_codes.values() for f in err.failures)
     assert "max_restarts=1" in str(err)
     assert ckdir in str(err)                      # names the resumable dir
+
+
+# ======================================================= elastic gangs
+def test_gang_shrink_on_spawn_fail(tmp_path):
+    """A rank whose SPAWN fails (exit 96) is classified permanently lost
+    on the spot: the supervisor shrinks the gang 2 -> 1, the survivor
+    completes training, and the SupervisorReport records the shrink (plus
+    the supervisor_world_size health gauge). The final model equals the
+    uninterrupted reference — replicated-serial gangs train the same
+    model at every world size."""
+    clean = _reference_model()
+    ckdir = str(tmp_path / "ck")
+    report = _run_faulted_gang(
+        {"LGBM_TPU_FAULT_SPAWN_FAIL_RANK": "1"}, ckdir)
+    assert report.restarts == 1
+    assert report.world_size == 1
+    assert len(report.shrinks) == 1
+    sh = report.shrinks[0]
+    assert (sh.from_nproc, sh.to_nproc, sh.lost_ranks) == (2, 1, [1])
+    assert "spawn failed" in sh.reason
+    fl = report.failures[0]
+    assert fl.exit_codes.get(1) == distributed.SPAWN_FAIL_EXIT_CODE
+    assert fl.spawn_failed_ranks == [1]
+    assert report.result == clean
+    from lightgbm_tpu.utils import profiling
+    assert profiling.gauges().get("supervisor_world_size") == 1.0
+    assert profiling.gauges().get("supervisor_shrinks") == 1.0
+
+
+def _gang_train_fn_rank1_machine_dead(rank, ckdir):
+    """Rank 1's 'machine' is permanently down: it dies whenever it exists,
+    across every incarnation (fn-level, so the supervisor's one-shot env
+    stripping cannot disarm it) — the budget-exhaustion shrink shape."""
+    if rank == 1:
+        os._exit(137)
+    return _gang_train_fn(rank, ckdir)
+
+
+@pytest.mark.slow
+def test_gang_shrink_on_rank_budget_exhausted(tmp_path):
+    """max_restarts accounting ACROSS a shrink: rank 1 dies every
+    incarnation; with rank_restart_budget=1 the supervisor burns one
+    same-size relaunch (failure 1 <= budget), then classifies rank 1
+    permanently lost (failure 2 > budget), shrinks 2 -> 1, and the world-1
+    gang completes — 2 restarts total, both counted against max_restarts.
+    (Tier-1 sibling: test_gang_shrink_on_spawn_fail covers the shrink
+    relaunch machinery; only the budget arithmetic is unique here.)"""
+    clean = _reference_model()
+    ckdir = str(tmp_path / "ck")
+    report = supervisor.run_supervised(
+        _gang_train_fn_rank1_machine_dead, nproc=2, args=(ckdir,),
+        devices_per_proc=1, checkpoint_dir=ckdir, max_restarts=3,
+        timeout=180, rank_restart_budget=1)
+    assert report.restarts == 2
+    assert report.world_size == 1
+    assert len(report.shrinks) == 1
+    assert report.shrinks[0].incarnation == 1      # 2nd failure triggered it
+    assert "budget 1" in report.shrinks[0].reason
+    assert [f.world_size for f in report.failures] == [2, 2]
+    assert report.result == clean
+
+
+@pytest.mark.slow
+def test_shrink_respects_min_world_size_and_max_restarts(tmp_path):
+    """Accounting edges: with min_world_size=2 a lost rank CANNOT shrink
+    a 2-gang, so max_restarts=0 exhausts immediately — the error carries
+    the failure (world size recorded, spawn-fail classified) and no
+    shrink is recorded. (Slow tier: the shrink relaunch machinery is
+    tier-1 via test_gang_shrink_on_spawn_fail; the give-up branch via
+    test_supervisor_gives_up_after_max_restarts.)"""
+    ckdir = str(tmp_path / "ck")
+    os.environ["LGBM_TPU_FAULT_SPAWN_FAIL_RANK"] = "1"
+    try:
+        with pytest.raises(supervisor.GangFailedError) as ei:
+            supervisor.run_supervised(
+                _gang_train_fn, nproc=2, args=(ckdir,), devices_per_proc=1,
+                checkpoint_dir=ckdir, max_restarts=0, timeout=180,
+                min_world_size=2)
+    finally:
+        os.environ.pop("LGBM_TPU_FAULT_SPAWN_FAIL_RANK", None)
+    err = ei.value
+    assert len(err.failures) == 1
+    assert err.failures[0].world_size == 2
+    assert err.failures[0].spawn_failed_ranks == [1]
+
+
+def test_heartbeat_after_shrink_no_ghost_suspects():
+    """After a 3 -> 2 shrink the new gang's monitors are built for
+    nproc=2 with renumbered ranks: a fully current 2-rank table implicates
+    nobody — the departed rank 3 numbering must NOT resurface as a
+    'missing' suspect."""
+    hb = HeartbeatMonitor(0, 2, "127.0.0.1:1", interval=0.5)
+    now = time.monotonic()
+    _progress.reset()
+    _progress.begin("step:4", 4)
+    try:
+        hb._server_table = {
+            0: {"iter": 3, "step": 4, "recv": now},
+            1: {"iter": 3, "step": 4, "recv": now},
+        }
+        assert hb.suspects(my_step=4, my_iter=3) == []
+    finally:
+        _progress.end(4)
+        _progress.reset()
+
+
+def test_suspects_during_relaunch_window():
+    """In the window between teardown and the next incarnation's first
+    ANSWERED heartbeat a non-zero rank's table is EMPTY: suspects() must
+    answer None (unknown), never implicate every rank (including the
+    caller). Rank 0's own table always contains at least itself, so a
+    freshly relaunched rank 0 names only genuinely absent peers."""
+    hb = HeartbeatMonitor(1, 2, "127.0.0.1:1", interval=0.5)
+    assert hb.suspects(my_step=0, my_iter=-1) is None
 
 
 # ============================================ single-process watchdog
