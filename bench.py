@@ -38,8 +38,39 @@ os.environ.setdefault("LIGHTGBM_TPU_TIMETAG", "1")
 
 BASELINE_SEC_PER_ITER = 130.094 / 500  # docs/Experiments.rst:108-124
 FULL_ROWS = 10_500_000
-# v5e peak: ~197 TFLOP/s bf16 / ~98 f32 (MFU denominator assumption)
+# v5e peaks (MFU denominator assumptions): the f32 number is the
+# conservative legacy denominator; the histogram pipeline's production
+# modes run bf16 (hilo: 2-pass) or int8 (q8) MXU passes, whose peaks are
+# 2x / 4x higher — reporting MFU against the WRONG peak overstates
+# (hilo vs f32) or hides (q8) the remaining headroom, so both
+# denominators are emitted and each probe uses its own mode's peak
 PEAK_F32_FLOPS = 98e12
+PEAK_FLOPS = {"f32": 98e12, "bf16": 197e12, "int8": 394e12}
+# histogram_method -> the MXU input rate its contraction actually runs at
+MODE_PEAK = {"auto": "bf16", "pallas_hilo": "bf16", "onehot_hilo": "bf16",
+             "pallas": "bf16", "onehot": "bf16",      # HIGHEST = bf16 passes
+             "pallas_q8": "int8", "onehot_q8": "int8",
+             "scatter": "f32", "binloop": "f32"}
+
+
+def mfu_estimates(sec_per_iter, rows, features, max_bin, num_leaves,
+                  hist_method="auto"):
+    """Nominal-useful-flops MFU against BOTH the f32 peak (the legacy
+    conservative denominator) and the bf16 peak, plus the mode-matched
+    number (``mfu_mode``: the peak of the MXU path this method actually
+    drives — int8 for q8, so quantized speedups are not flattered by an
+    f32 denominator). Nominal work is mode-independent: the dense
+    histogram pass's 2*N*F*B*S MACs, ~log2(num_leaves) passes per tree
+    with subtraction."""
+    import math
+    nominal = (2.0 * rows * features * max_bin * 3
+               * math.ceil(math.log2(max(num_leaves, 2))))
+    per_sec = nominal / max(sec_per_iter, 1e-12)
+    return {
+        "mfu_f32": per_sec / PEAK_FLOPS["f32"],
+        "mfu_bf16": per_sec / PEAK_FLOPS["bf16"],
+        "mfu_mode": per_sec / PEAK_FLOPS[MODE_PEAK.get(hist_method, "f32")],
+    }
 
 
 def _health_json():
@@ -57,7 +88,8 @@ def _health_json():
         return None
 
 
-def run_at_scale(rows, args, hist_method="auto", hist_compaction=True):
+def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
+                 extra_params=None):
     import numpy as np
     import jax
     import lightgbm_tpu as lgb
@@ -112,6 +144,7 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True):
         "histogram_method": hist_method,
         "hist_compaction": hist_compaction,
         "verbosity": -1,
+        **(extra_params or {}),
     }, train_set=ds)
 
     # warmup (jit compile + first real iterations)
@@ -221,16 +254,24 @@ def main():
     ap.add_argument("--probe-deadline", type=int, default=2400,
                     help="stop starting secondary probes (q8/bin63) after "
                          "this many seconds of total wall time")
+    ap.add_argument("--probe-timeout", type=int, default=180,
+                    help="hard deadline (s) on the TPU backend-init probe "
+                         "subprocess before falling back to CPU")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     ap.add_argument("--no-ladder", action="store_true",
                     help="fail instead of retrying at smaller scales")
     args = ap.parse_args()
 
+    # backend-probe outcome for the result JSON: a CPU number that LOOKS
+    # like a TPU number poisons round-over-round comparisons, so the
+    # backend actually used and WHY the TPU was rejected are first-class
+    # fields, not stderr comments
+    probe_error = None
     if not args.cpu and os.environ.get("_LGB_TPU_BENCH_PROBED") != "1":
         # the axon tunnel can wedge so that backend init HANGS (observed
         # 2026-07-30: a dead tunnel blocks jax.devices() indefinitely);
-        # probe it in a killable subprocess and fall back to CPU so the
-        # bench always reports a number
+        # probe it in a killable subprocess with a hard deadline and fall
+        # back to CPU so the bench always reports a number
         import subprocess
         env = dict(os.environ)
         env["_LGB_TPU_BENCH_PROBED"] = "1"
@@ -238,12 +279,20 @@ def main():
             probe = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; print(jax.devices()[0].platform)"],
-                env=env, timeout=180, capture_output=True, text=True)
-            ok = probe.returncode == 0
+                env=env, timeout=args.probe_timeout, capture_output=True,
+                text=True)
+            if probe.returncode != 0:
+                tail = (probe.stderr or "").strip().splitlines()[-3:]
+                probe_error = (f"probe exited {probe.returncode}: "
+                               + " | ".join(tail)[:500])
+            elif probe.stdout.strip().splitlines()[-1:] != ["tpu"]:
+                probe_error = ("probe found no TPU (platform="
+                               f"{probe.stdout.strip()[:100]!r})")
         except subprocess.TimeoutExpired:
-            ok = False
-        if not ok:
-            print("# TPU backend unavailable (probe failed/hung); "
+            probe_error = (f"probe hung past {args.probe_timeout}s "
+                           "(backend init deadlock / dead tunnel)")
+        if probe_error:
+            print(f"# TPU backend unavailable ({probe_error}); "
                   "falling back to CPU", file=sys.stderr)
             args.cpu = True
             # a CPU run is a diagnostic number, not the benchmark: cap the
@@ -295,15 +344,21 @@ def main():
     # baseline scaled to the rows actually benchmarked (reference cost is
     # ~linear in rows at fixed features/bins/leaves)
     scaled_baseline = BASELINE_SEC_PER_ITER * used_rows / FULL_ROWS
-    # MFU estimate: nominal useful work of dense histogram construction,
+    # MFU estimates: nominal useful work of dense histogram construction,
     # ~log2(num_leaves) full-data passes per tree with subtraction
-    # (2*N*F*B*S flops per pass), over the measured wall time
-    import math
-    nominal_flops = (2.0 * used_rows * args.features * args.max_bin * 3
-                     * math.ceil(math.log2(max(args.num_leaves, 2))))
-    mfu = nominal_flops / sec_per_iter / PEAK_F32_FLOPS
-    print(f"# MFU estimate (dense-hist useful flops / f32 peak): {mfu:.4f}",
-          file=sys.stderr)
+    # (2*N*F*B*S flops per pass), over the measured wall time — against
+    # BOTH peaks (see mfu_estimates)
+    # resolve "auto" to what actually ran before picking the mode peak:
+    # on a CPU-fallback round "auto" runs scatter (f32), not the bf16
+    # kernel — the mode-matched MFU must use the executed path's peak
+    from lightgbm_tpu.ops.histogram import resolve_method
+    mfu_d = mfu_estimates(sec_per_iter, used_rows, args.features,
+                          args.max_bin, args.num_leaves,
+                          resolve_method(used_method))
+    mfu = mfu_d["mfu_f32"]
+    print(f"# MFU estimate (dense-hist useful flops): "
+          f"f32-peak {mfu:.4f} / bf16-peak {mfu_d['mfu_bf16']:.4f} / "
+          f"mode-peak {mfu_d['mfu_mode']:.4f}", file=sys.stderr)
 
     result = {
         "metric": f"higgs{used_rows/1e6:.1f}M_sec_per_iter",
@@ -312,10 +367,19 @@ def main():
                 f"{args.num_leaves} leaves, {args.max_bin} bins, binary)",
         "vs_baseline": round(scaled_baseline / sec_per_iter, 4),
         "rows": used_rows,
+        # legacy field: f32-peak denominator; the bf16/mode numbers answer
+        # "how much of the hardware the production (bf16/int8) MXU paths
+        # actually use" — the f32 one alone overstated hilo by 2x
         "mfu_est": round(mfu, 4),
+        "mfu_bf16_est": round(mfu_d["mfu_bf16"], 4),
+        "mfu_mode_est": round(mfu_d["mfu_mode"], 4),
         "auc": round(auc, 6) if auc is not None else None,
         "auc_rounds": rounds_run,
         "hist_method": used_method,
+        # backend-probe outcome (satellite: the fallback reason must be in
+        # the JSON, not only a stderr comment)
+        "backend": jax.default_backend(),
+        "probe_error": probe_error,
         # dispatch/host-sync telemetry over the timed loop (see
         # utils/profiling.py install_dispatch_hook): compiled-program
         # launches and explicit host<->device transfer bytes per
@@ -386,21 +450,53 @@ def main():
     })
     print(json.dumps(result), flush=True)
 
-    # secondary probe: the opt-in int8 quantized-gradient mode, WITH its
-    # own held-out AUC so quality-at-speed is on record (the promotion
-    # gate for folding q8 into "auto" is AUC within ~0.001 of the default
-    # path — the same tolerance the reference publishes for its GPU
+    # secondary probes: the quantized-gradient mode and the max_bin=63
+    # configuration. These run on EVERY backend (they were TPU-gated
+    # before, which left the q8_*/bin63_* fields permanently null on CPU
+    # fallback rounds — BENCH_r05): on TPU they measure the Pallas q8
+    # kernel; on CPU the same quantized_grad training resolves to the XLA
+    # int8 contraction, so the speed/quality tradeoff is still on record.
+    # CPU probes shrink to diagnostic scale so the round fits its budget.
+    if jax.default_backend() == "tpu":
+        probe_args = args
+        probe_rows = used_rows
+    else:
+        probe_args = argparse.Namespace(**{
+            **vars(args),
+            "rounds": min(args.rounds, 15),
+            "iters": min(args.iters, 5),
+            "valid_rows": min(args.valid_rows, 50_000)})
+        probe_rows = min(used_rows, 200_000)
+
+    # quantized-gradient training (Config.quantized_grad): int8 grad/hess
+    # with stochastic rounding, exact int32 histogram accumulation, f32
+    # rescale at split-gain time — WITH its own held-out AUC so
+    # quality-at-speed is on record (the promotion gate for folding q8
+    # into "auto" is AUC within ~0.002 of the default path — the same
+    # kind of tolerance the reference publishes for its GPU
     # float32-histogram mode, docs/GPU-Performance.rst:133-140)
-    q8_sec = q8_auc = None
-    if (used_method == "auto" and jax.default_backend() == "tpu"
-            and probe_headroom("q8")):
+    q8_sec = q8_auc = q8_mfu = q8_ref_auc = None
+    if probe_headroom("q8"):
         try:
             q8_sec, q8_ph, q8_auc, _, _, _, _, _, _ = run_at_scale(
-                used_rows, args, hist_method="pallas_q8")
-            print(f"# q8 probe: {q8_sec:.3f} s/iter, auc={q8_auc}",
-                  file=sys.stderr)
+                probe_rows, probe_args, hist_method="auto",
+                extra_params={"quantized_grad": True})
+            q8_mfu = mfu_estimates(
+                q8_sec, probe_rows, probe_args.features, probe_args.max_bin,
+                probe_args.num_leaves, "pallas_q8")["mfu_mode"]
+            print(f"# q8 probe: {q8_sec:.3f} s/iter, auc={q8_auc}, "
+                  f"int8-peak mfu={q8_mfu:.4f}", file=sys.stderr)
             for kk, vv in q8_ph.items():
                 print(f"# q8 phase {kk}: {vv:.3f}s", file=sys.stderr)
+            if (probe_rows, probe_args.rounds) == (used_rows, args.rounds):
+                q8_ref_auc = auc    # main run IS the matched f32 reference
+            elif probe_headroom("q8-f32-ref"):
+                # reduced-scale probe (CPU fallback): the q8 AUC needs an
+                # f32 reference at the SAME scale to be a quality delta
+                _, _, q8_ref_auc, _, _, _, _, _, _ = run_at_scale(
+                    probe_rows, probe_args, hist_method=used_method)
+                print(f"# q8 f32 reference auc={q8_ref_auc}",
+                      file=sys.stderr)
         except Exception:
             traceback.print_exc(file=sys.stderr)
             print("# q8 probe failed; omitting", file=sys.stderr)
@@ -408,15 +504,15 @@ def main():
     # max_bin=63: the reference's RECOMMENDED GPU configuration with
     # published AUC parity (docs/GPU-Performance.rst:43-47: CPU-255
     # 0.845612 vs GPU-63 0.845209 on Higgs) — ~4x fewer one-hot MACs per
-    # histogram pass. Timed at the same scale with its own AUC readout so
-    # speed-at-matched-quality is on the record.
+    # histogram pass (and full 128-row MXU tiles via the kernel's
+    # feature packing). Timed at the probe scale with its own AUC readout
+    # so speed-at-matched-quality is on the record.
     b63_sec = b63_auc = b63q8_sec = b63q8_auc = None
-    if (used_method == "auto" and jax.default_backend() == "tpu"
-            and args.max_bin != 63 and probe_headroom("bin63")):
+    if args.max_bin != 63 and probe_headroom("bin63"):
+        b63_args = argparse.Namespace(**{**vars(probe_args), "max_bin": 63})
         try:
-            b63_args = argparse.Namespace(**{**vars(args), "max_bin": 63})
             b63_sec, b63_ph, b63_auc, _, _, _, _, _, _ = run_at_scale(
-                used_rows, b63_args, hist_method="auto")
+                probe_rows, b63_args, hist_method="auto")
             print(f"# max_bin=63: {b63_sec:.3f} s/iter, "
                   f"auc={b63_auc}", file=sys.stderr)
             for kk, vv in b63_ph.items():
@@ -429,7 +525,8 @@ def main():
         if probe_headroom("bin63+q8"):
             try:
                 b63q8_sec, _, b63q8_auc, _, _, _, _, _, _ = run_at_scale(
-                    used_rows, b63_args, hist_method="pallas_q8")
+                    probe_rows, b63_args, hist_method="auto",
+                    extra_params={"quantized_grad": True})
                 print(f"# max_bin=63 + q8: {b63q8_sec:.3f} s/iter, "
                       f"auc={b63q8_auc}", file=sys.stderr)
             except Exception:
@@ -438,8 +535,17 @@ def main():
                       file=sys.stderr)
 
     result.update({
+        # probe scale differs from the main run on CPU fallback rounds —
+        # record it so q8/bin63 numbers are compared against the right
+        # denominator
+        "probe_rows": probe_rows,
         "q8_sec_per_iter": round(q8_sec, 4) if q8_sec is not None else None,
         "q8_auc": round(q8_auc, 6) if q8_auc is not None else None,
+        # f32 AUC at the probe's own scale/rounds — the denominator of the
+        # q8 quality delta (equals the headline auc when scales match)
+        "q8_f32_ref_auc": round(q8_ref_auc, 6)
+        if q8_ref_auc is not None else None,
+        "q8_mfu_int8_est": round(q8_mfu, 4) if q8_mfu is not None else None,
         "bin63_sec_per_iter": round(b63_sec, 4) if b63_sec is not None
         else None,
         "bin63_auc": round(b63_auc, 6) if b63_auc is not None else None,

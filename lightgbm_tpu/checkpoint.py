@@ -70,6 +70,14 @@ _NON_TRAINING_PARAMS = frozenset({
     "metric_freq", "num_threads", "machine_list_filename",
     "checkpoint_path", "checkpoint_keep", "checkpoint_shards",
     "check_numerics",
+    # kernel-shape tuning: an execution-strategy knob (block-size choice
+    # regroups partial sums at the same f32 tolerance every pass-shape
+    # change does). hist_pallas_interpret is NOT here: off-TPU it changes
+    # which algorithm "auto" resolves to (scatter vs the hilo kernel),
+    # i.e. the histogram rounding model — the same class of drift as
+    # histogram_method itself, which is hashed. quantized_grad is NOT
+    # here — it changes the trained model.
+    "hist_autotune",
     "heartbeat_interval", "collective_deadline", "max_restarts",
     "rank_restart_budget", "min_world_size",
     "fault_kill_at_iter", "fault_hang_at_iter", "fault_kill_in_ckpt_write",

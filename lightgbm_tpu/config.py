@@ -372,8 +372,31 @@ class Config:
     # reference's leaf-wise order (one histogram round per split).
     tree_growth_mode: str = "batched"
     histogram_method: str = "auto"                  # auto|scatter|binloop|onehot|onehot_hilo|onehot_q8|pallas|pallas_hilo|pallas_q8
+    # quantized-gradient training (the XGBoost-GPU recipe, arXiv:1706.08359
+    # §5; LightGBM 4.x quantized training re-designed for the MXU):
+    # grad/hess quantize to int8 with stochastic rounding, histograms
+    # accumulate EXACTLY in int32 on the int8 MXU path (~2x the bf16 rate),
+    # and rescale to f32 once per tile at split-gain time. Maps
+    # histogram_method onto its q8 twin (pallas_q8 on TPU, onehot_q8
+    # elsewhere); excluded with gpu_use_dp
+    quantized_grad: bool = False
     tile_leaves: int = 0                            # hist tile width (0 = auto: 42)
     hist_block: int = 0                             # hist row-block size (0 = auto per method)
+    # measured Pallas kernel tuning on TPU (ops/pallas_hist.py
+    # autotune_hist): times the candidate row-block sizes once per shape
+    # bucket (keyed like the predict engine's compile cache) and picks the
+    # leaf batch structurally (the widest tile in the 128-lane group);
+    # explicit tile_leaves/hist_block values always win. Serial learner
+    # only — the parallel learners keep the static defaults (a measured
+    # winner is wall-clock-dependent and the method/block are static SPMD
+    # program parameters that must match across shards)
+    hist_autotune: bool = True
+    # run the Pallas histogram kernels through the Pallas INTERPRETER on
+    # non-TPU backends (tests/CI): the production TPU pipeline — fused
+    # leaf channels, in-kernel row gather, q8 — becomes CPU-testable;
+    # never set in production (the interpreter is orders of magnitude
+    # slower than the XLA fallbacks)
+    hist_pallas_interpret: bool = False
     # histogram subtraction trick (serial_tree_learner.cpp:311-320): build
     # only the smaller sibling and derive the larger as parent - smaller
     hist_subtraction: bool = True

@@ -456,6 +456,13 @@ class GBDT:
         # gpu_use_dp analog: float64 histogram accumulation (the reference
         # CPU's hist_t precision; bin.h:32) — requires jax x64
         self._hist_dp = bool(cfg.gpu_use_dp)
+        if cfg.quantized_grad and self._hist_dp:
+            # checked on the CONFIG flags, before the x64-availability
+            # demotion below — the contradiction is in what was asked for
+            raise ValueError(
+                "quantized_grad and gpu_use_dp are exclusive: int8 "
+                "histograms with stochastic rounding and f64 accumulation "
+                "contradict each other — pick one precision model")
         if self._hist_dp and not jax.config.jax_enable_x64:
             log.warning("gpu_use_dp=true needs jax x64 (set JAX_ENABLE_X64=1 "
                         "or jax.config.update('jax_enable_x64', True)); "
@@ -762,10 +769,12 @@ class GBDT:
         ts = self.train_set
         has_sp = getattr(ts, "has_sparse_cols", False)
         fb = self._feature_block(hm)
+        tile, blk = self._hist_tuning(hm)
         return dict(
             max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
             max_depth=cfg.max_depth, hist_method=hm,
-            tile_leaves=cfg.tile_leaves, hist_block=cfg.hist_block,
+            tile_leaves=tile, hist_block=blk,
+            hist_interpret=self._hist_interpret(),
             feature_block=fb,
             exact=cfg.tree_growth_mode == "exact",
             with_categorical=ts.has_categorical,
@@ -793,6 +802,7 @@ class GBDT:
             max_depth=cfg.max_depth, hist_method=hm,
             tile_leaves=cfg.tile_leaves,
             hist_block=cfg.hist_block,
+            hist_interpret=self._hist_interpret(),
             exact=cfg.tree_growth_mode == "exact",
             with_categorical=ts.has_categorical,
             with_monotone=self._with_monotone,
@@ -1364,9 +1374,58 @@ class GBDT:
         local = np.concatenate([np.asarray(s.data) for s in shards])
         return jnp.asarray(local[:n_local])
 
+    def _hist_interpret(self) -> bool:
+        """Run Pallas histogram kernels through the interpreter: only when
+        asked (hist_pallas_interpret) and only off-TPU — on TPU the real
+        kernel always wins and the flag is inert."""
+        return (self.config.hist_pallas_interpret
+                and jax.default_backend() != "tpu")
+
+    def _hist_tuning(self, hm: str) -> tuple:
+        """(tile_leaves, hist_block) for the serial grow statics: explicit
+        config values always win; otherwise the Pallas autotuner supplies
+        the measured block size and structural leaf batch for this shape
+        bucket (ops/pallas_hist.py autotune_hist — a no-op returning
+        defaults off-TPU and for non-Pallas methods). Cached on the
+        booster: the statics must stay stable across iterations or every
+        tree would re-jit the grower."""
+        cfg = self.config
+        tile, blk = cfg.tile_leaves, cfg.hist_block
+        if (not cfg.hist_autotune or not hm.startswith("pallas")
+                or (tile and blk) or self.train_set is None
+                or jax.process_count() > 1):
+            return tile, blk
+        if blk:
+            # only the leaf batch is missing, and that choice is purely
+            # structural (widest tile in the 128-lane group) — don't pay
+            # the measured block sweep just to discard its winner
+            from ..ops.pallas_hist import structural_tile_leaves
+            return tile or structural_tile_leaves(), blk
+        hit = getattr(self, "_hist_tuned", None)
+        if hit is None:
+            binsT = (self.train_set.bins_T if self._use_binsT(hm) else None)
+            if binsT is None:
+                hit = {"block": 0, "tile_leaves": 0}
+            else:
+                from ..ops.pallas_hist import autotune_hist
+                hit = autotune_hist(
+                    binsT, self.train_set.max_num_bins,
+                    mode={"pallas": "highest", "pallas_hilo": "hilo",
+                          "pallas_q8": "q8"}[hm])
+            self._hist_tuned = hit
+        return tile or hit["tile_leaves"], blk or hit["block"]
+
     def _hist_method(self) -> str:
         from ..ops.histogram import measured_auto_method, resolve_method
         cfg = self.config
+        if cfg.quantized_grad:
+            # the quantized-gradient training mode overrides the measured
+            # auto-selection: q8 changes numerics, so it is chosen by the
+            # user, never by the timer
+            return resolve_method(cfg.histogram_method,
+                                  deterministic=cfg.deterministic,
+                                  quantized=True,
+                                  interpret=self._hist_interpret())
         if (cfg.histogram_method == "auto" and not cfg.deterministic
                 and jax.default_backend() == "tpu"
                 and self.train_set is not None
@@ -1387,7 +1446,8 @@ class GBDT:
                 self._measured_hm = hit
             return hit
         return resolve_method(cfg.histogram_method,
-                              deterministic=cfg.deterministic)
+                              deterministic=cfg.deterministic,
+                              interpret=self._hist_interpret())
 
     def _sample_weights(self, g, h) -> Optional[jax.Array]:
         """Hook for GOSS-style reweighted sampling; None = use bag mask."""
@@ -1821,10 +1881,13 @@ class GBDT:
             "rows_streamed": float(self._rows_streamed_dev),
             "coll_bytes": float(self._coll_bytes_dev),
             "best_score": dict(self.best_score),
-            # the measured-auto histogram method is timing-dependent: the
-            # resumed process must reuse the original run's choice or the
-            # compiled program (and float accumulation order) could differ
+            # the measured-auto histogram method and the autotuned Pallas
+            # kernel shape are timing-dependent: the resumed process must
+            # reuse the original run's choices or the compiled program
+            # (and float accumulation order) could differ — breaking the
+            # bit-identical-restart contract
             "measured_hm": getattr(self, "_measured_hm", None),
+            "hist_tuned": getattr(self, "_hist_tuned", None),
             "cegb_aux": (jax.device_get(self._cegb_aux)
                          if self._cegb_aux is not None else None),
             "loaded_iters": self.loaded_iters,
@@ -1865,6 +1928,8 @@ class GBDT:
         self.best_score = dict(state["best_score"])
         if state.get("measured_hm") is not None:
             self._measured_hm = state["measured_hm"]
+        if state.get("hist_tuned") is not None:
+            self._hist_tuned = state["hist_tuned"]
         if state.get("cegb_aux") is not None:
             self._cegb_aux = jax.tree.map(jnp.asarray, state["cegb_aux"])
         if state.get("loaded_model_text"):

@@ -471,7 +471,7 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
                      "hist_subtraction", "feature_block",
                      "feature_axis_name", "feature_shards", "voting",
                      "vote_top_k", "hist_dp", "sp_cols",
-                     "compaction_ladder"))
+                     "compaction_ladder", "hist_interpret"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
               feature_mask: jax.Array, missing_bin: jax.Array, *,
@@ -513,6 +513,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sp_bins: jax.Array | None = None,
               sp_default: jax.Array | None = None,
               compaction_ladder: tuple = (),
+              hist_interpret: bool = False,
               ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
     """Grow one tree. Returns (tree arrays, per-row leaf index, aux state).
 
@@ -989,7 +990,8 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             t = histogram_tiles(bins_h, stats, hist_leaf_ids, sel,
                                 num_bins, method=hist_method,
                                 dtype=hist_dtype,
-                                binsT=binsT_h, block=hist_block)
+                                binsT=binsT_h, block=hist_block,
+                                interpret=hist_interpret)
             return t, jnp.float32(n_rows)
 
         if f_dense > 0 and compaction_ladder:
@@ -1002,15 +1004,24 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             in_tile = slot_map[hist_leaf_ids] < P
             n_pend = jnp.sum(in_tile, dtype=jnp.int32)
 
+            # every rung hands histogram_tiles the row-INDEX buffer: the
+            # Pallas kernels gather the rows IN KERNEL from the
+            # HBM-resident full arrays (pallas_hist fusion 2 — no
+            # compacted [F, m] copy exists), while the XLA backends expand
+            # the same buffer with exactly compact_rows' semantics (same
+            # stable order, clamp, -2 leaf fill) — one rung definition,
+            # no branch pair to keep in sync
             def compact_pass(m):
                 def fn():
-                    from ..ops.histogram import compact_rows
-                    bm, btm, st, lid = compact_rows(
-                        bins_h, binsT_h, stats, hist_leaf_ids, in_tile, m)
-                    t = histogram_tiles(bm, st, lid, sel, num_bins,
+                    from ..ops.histogram import compact_indices
+                    idx = compact_indices(in_tile, m)
+                    t = histogram_tiles(bins_h, stats, hist_leaf_ids,
+                                        sel, num_bins,
                                         method=hist_method,
                                         dtype=hist_dtype,
-                                        binsT=btm, block=hist_block)
+                                        binsT=binsT_h, block=hist_block,
+                                        gather_idx=idx,
+                                        interpret=hist_interpret)
                     return t, jnp.float32(m)
                 return fn
 
@@ -1343,7 +1354,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                 bins[:, s_:e_], stats, state.leaf_id, sel, num_bins,
                 method=hist_method, dtype=hist_dtype,
                 binsT=binsT[s_:e_] if binsT is not None else None,
-                block=hist_block)
+                block=hist_block, interpret=hist_interpret)
             mb = FeatureMeta(*(a[s_:e_] for a in meta))
             bundle_b = (type(bundle_meta)(*(a[s_:e_] for a in bundle_meta))
                         if bundle_meta is not None else None)

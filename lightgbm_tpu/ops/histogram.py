@@ -111,6 +111,17 @@ def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     return parent - child
 
 
+def compact_indices(keep: jax.Array, size: int) -> jax.Array:
+    """[size] int32 prefix-sum compaction of the ``keep`` rows' indices
+    (original row order — jnp.nonzero is stable); padding slots carry N.
+    This is the compaction ladder's row-index buffer: the Pallas gather
+    kernel consumes it directly (pallas_hist fusion 2 — rows are gathered
+    IN KERNEL and no compacted copy touches HBM), while the XLA backends
+    expand it through compact_rows."""
+    n = keep.shape[0]
+    return jnp.nonzero(keep, size=size, fill_value=n)[0].astype(jnp.int32)
+
+
 def compact_rows(bins: jax.Array | None, binsT: jax.Array | None,
                  stats: jax.Array, leaf_ids: jax.Array, keep: jax.Array,
                  size: int):
@@ -144,7 +155,7 @@ def compact_rows(bins: jax.Array | None, binsT: jax.Array | None,
       (None stays None).
     """
     n = leaf_ids.shape[0]
-    idx = jnp.nonzero(keep, size=size, fill_value=n)[0].astype(jnp.int32)
+    idx = compact_indices(keep, size)
     ok = idx < n
     idxc = jnp.minimum(idx, n - 1)
     stats_c = jnp.where(ok[:, None], jnp.take(stats, idxc, axis=0),
@@ -164,13 +175,15 @@ def _round_up(n: int, m: int) -> int:
 _pallas_fallback_warned: set = set()
 
 
-def resolve_method(method: str, deterministic: bool = False) -> str:
+def resolve_method(method: str, deterministic: bool = False,
+                   quantized: bool = False, interpret: bool = False) -> str:
     """Map ``histogram_method="auto"`` to the platform's fast backend
     (the analog of the reference's col-wise/row-wise auto benchmark,
     dataset.cpp:591-689 TestMultiThreadingMethod — here the choice is
     platform-structural: scatter-add is fast on CPU hosts and pathologically
-    serialized on TPU, where the fused Pallas kernel wins; measured on v5e
-    at Higgs shape the ladder is pallas_hilo < pallas ~ onehot << scatter).
+    serialized on TPU, where the fused Pallas kernel is the primary path;
+    measured on v5e at Higgs shape the ladder is
+    pallas_q8 < pallas_hilo < pallas ~ onehot << scatter).
 
     ``pallas_hilo`` rounds grad/hess inputs to a hi+lo bf16 pair (~2^-17
     relative, vs f32's 2^-24) before the MXU contraction; near-tied split
@@ -179,13 +192,35 @@ def resolve_method(method: str, deterministic: bool = False) -> str:
     the HIGHEST-precision kernel so results are stable across
     histogram-method choices at ~1.7x the pass cost.
 
+    ``quantized=True`` (Config.quantized_grad, the end-to-end int8
+    quantized-gradient training mode) maps the resolved method onto its
+    q8 twin: the Pallas kernel on TPU, the XLA int8 contraction elsewhere
+    (scatter/binloop have no integer-accumulation form — they resolve to
+    onehot_q8 with a one-time note).
+
+    ``interpret=True`` (Config.hist_pallas_interpret) keeps ``auto`` on the
+    Pallas kernels OFF-TPU too, running them through the Pallas
+    interpreter — the CPU test path for the production TPU pipeline.
+
     ``histogram_tiles`` falls back from a pallas method to the equivalent
     XLA onehot contraction when the kernel's preconditions don't hold
-    (non-TPU backend, no feature-major bins, f64 accumulation, or
-    tile_leaves*stats exceeding the 128-lane group) and warns once per
-    precondition."""
+    (non-TPU backend without interpret, no feature-major bins, f64
+    accumulation, or tile_leaves*stats exceeding the 128-lane group) and
+    warns once per precondition."""
+    on_kernel = jax.default_backend() == "tpu" or interpret
+    if quantized:
+        if method in ("auto", "pallas", "pallas_hilo", "pallas_q8"):
+            return "pallas_q8" if on_kernel else "onehot_q8"
+        if method in ("scatter", "binloop"):
+            key = ("quantized_grad", method)
+            if key not in _pallas_fallback_warned:
+                _pallas_fallback_warned.add(key)
+                from ..utils import log
+                log.info(f"quantized_grad: histogram_method={method!r} has "
+                         "no integer-accumulation form; using onehot_q8")
+        return "onehot_q8"
     if method == "auto":
-        if jax.default_backend() != "tpu":
+        if not on_kernel:
             return "scatter"
         return "pallas" if deterministic else "pallas_hilo"
     return method
@@ -259,7 +294,9 @@ def measured_auto_method(bins, binsT, num_bins: int, tile_leaves: int = 42,
 def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
                     sel: jax.Array, num_bins: int, method: str = "onehot",
                     block: int = 0, dtype=jnp.float32,
-                    binsT: jax.Array | None = None) -> jax.Array:
+                    binsT: jax.Array | None = None,
+                    gather_idx: jax.Array | None = None,
+                    interpret: bool = False) -> jax.Array:
     """Histograms for a TILE of leaves.
 
     Slot ``p`` of the output accumulates the rows whose ``leaf_ids`` equals
@@ -275,23 +312,34 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
       leaf_ids: [N] leaf slot of each row.
       sel: [P] int32 leaf ids selected into this tile (-1 = inactive slot).
       num_bins: bins per feature B (static).
+      gather_idx: optional [M] int32 compacted row-index buffer
+        (compact_indices output; entries >= N are padding). The Pallas
+        kernels consume it directly — rows are gathered IN KERNEL from the
+        HBM-resident arrays (pallas_hist fusion 2) and the pass covers M
+        instead of N rows. Non-Pallas backends (and Pallas fallbacks)
+        expand it into compacted copies first, which is what the ladder
+        did before the fusion.
+      interpret: run Pallas kernels through the interpreter (CPU test
+        path, Config.hist_pallas_interpret); ignored by XLA backends.
 
     Returns:
       [P, F, B, S] float32 histogram.
     """
-    n, f = bins.shape
+    n, f = bins.shape if bins is not None else binsT.shape[::-1]
     p = sel.shape[0]
     s = stats.shape[1]
 
     if method in ("pallas", "pallas_hilo", "pallas_q8"):
-        # the fused kernel needs: real TPU lowering, the feature-major bin
-        # matrix, f32 accumulation, and the tile x stat channels within one
-        # 128-lane group; otherwise run the XLA onehot formulation of the
-        # same contraction. ``reasons`` IS the gate: empty means every
-        # precondition holds, so the warning can never disagree with it.
+        # the fused kernel needs: real TPU lowering (or the interpreter),
+        # the feature-major bin matrix, f32 accumulation, and the tile x
+        # stat channels within one 128-lane group; otherwise run the XLA
+        # onehot formulation of the same contraction. ``reasons`` IS the
+        # gate: empty means every precondition holds, so the warning can
+        # never disagree with it.
         reasons = []
-        if jax.default_backend() != "tpu":
-            reasons.append(f"backend is {jax.default_backend()!r}, not tpu")
+        if jax.default_backend() != "tpu" and not interpret:
+            reasons.append(f"backend is {jax.default_backend()!r}, not tpu "
+                           "(set hist_pallas_interpret=true to emulate)")
         if binsT is None:
             reasons.append("feature-major bin matrix (binsT) unavailable")
         if not (dtype == jnp.float32 or method == "pallas_q8"):
@@ -306,7 +354,8 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
                      "pallas_q8": "q8"}[method]
             return pallas_hist.histogram_tiles_pallas_mode(
                 binsT, stats, leaf_ids, sel, num_bins,
-                block=block or 2048, mode=kmode)
+                block=block or 2048, mode=kmode, idx=gather_idx,
+                interpret=interpret and jax.default_backend() != "tpu")
         # an explicitly requested kernel silently degrading to the XLA
         # formulation is a large perf cliff — name the violated
         # precondition once so the user can tell why
@@ -319,6 +368,19 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
                 f"formulation: {'; '.join(reasons)}")
         method = {"pallas": "onehot", "pallas_hilo": "onehot_hilo",
                   "pallas_q8": "onehot_q8"}[method]
+
+    if gather_idx is not None:
+        # XLA backends can't gather in kernel: expand the index buffer into
+        # compacted copies (exactly what the pre-fusion ladder did) and run
+        # the pass over those
+        ok = gather_idx < n
+        idxc = jnp.minimum(gather_idx, n - 1)
+        stats = jnp.where(ok[:, None], jnp.take(stats, idxc, axis=0),
+                          jnp.zeros((), stats.dtype))
+        leaf_ids = jnp.where(ok, jnp.take(leaf_ids, idxc), jnp.int32(-2))
+        bins = None if bins is None else jnp.take(bins, idxc, axis=0)
+        binsT = None if binsT is None else jnp.take(binsT, idxc, axis=1)
+        n = gather_idx.shape[0]
 
     if method in ("onehot", "onehot_hilo", "onehot_q8"):
         # "onehot_q8": int8 MXU contraction for QUANTIZED stats (the

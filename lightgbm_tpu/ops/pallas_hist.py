@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the histogram tile pass.
+"""Pallas TPU kernels for the histogram tile pass — the primary TPU path.
 
 The fused re-design of the CUDA histogram kernels (reference:
 src/treelearner/kernels/histogram_16_64_256.cu:16-120 — per-workgroup
@@ -7,31 +7,57 @@ instead each grid step builds the per-feature bin one-hot IN VMEM and
 contracts it with the (leaf-slot x stat) channel matrix on the MXU,
 accumulating into a VMEM-resident [F*B, P*S] output that is flushed once.
 
-Why a kernel at all: the XLA formulation (histogram.py "onehot") must
-materialize the ``[C, F*B]`` one-hot in HBM — ~300 GB of traffic per full
-pass at Higgs scale, which bounds the pass at ~370-450 ms. Fused, the
-one-hot never leaves VMEM and the pass is bounded by the bin-compare VPU
-work (~75 G ops) plus the matmuls.
+Three fusions keep the pass's HBM traffic at the bin matrix itself:
 
-Two precision modes share one kernel body (``hilo`` flag):
+1. **In-kernel leaf channels.** The (leaf-onehot x stats) RHS is built
+   inside the grid step from the raw ``[N]`` leaf ids and ``[N, S]`` stats.
+   The previous design prepared an ``[N, 128]`` f32 RHS in XLA — ~18x the
+   HBM bytes of the int8 bin matrix it accompanied (25x+ in the hilo mode's
+   bf16-pair form), written and re-read every pass. Fused, the RHS never
+   exists outside VMEM: per-pass traffic drops to
+   ``bins + stats + leaf_ids + output``.
 
-- hilo=True (the fast default): the rhs carries [hi || lo] bf16 halves of
-  the f32 channels; both halves' products accumulate in f32 on the MXU, so
-  the recombined sum carries ~16-17 mantissa bits of input precision
-  (~2^-17 relative rounding) with exact counts — comparable to (slightly
-  coarser than) the reference GPU's float32 histograms (gpu_use_dp=false,
-  docs/GPU-Performance.rst:133-140), at 2 bf16 MXU passes.
-- hilo=False: f32 rhs contracted at Precision.HIGHEST (6 bf16 passes) —
-  the precise alternative.
+2. **In-kernel row gather.** The compaction ladder (ops/histogram.py,
+   the DataPartition analog) used to materialize a compacted ``[F, N/r]``
+   bin-matrix copy in HBM (``jnp.take``) that the kernel then re-read. The
+   gather form of the kernel instead takes the ladder's row-index buffer
+   directly (scalar-prefetched to SMEM) and DMAs the pending rows' bin
+   columns / stats / leaf ids from the HBM-resident full arrays into VMEM
+   scratch inside the grid step — the paged-attention idiom at row
+   granularity. The compacted copy is never materialized; per-pass traffic
+   is the touched rows plus the index buffer. (Row-granularity DMA is
+   latency- not bandwidth-bound; the ladder only selects this form when the
+   rung is <= N/2, where the full-pass alternative reads >= 2x the bytes.)
 
-The leaf-channel RHS (leaf one-hot x stats, P*S columns padded to the
-128-lane boundary) is prepared by XLA — it is small (~2% of the one-hot's
-traffic).
+3. **Quantized-gradient mode.** ``mode="q8"`` contracts int8 stats with the
+   int8 one-hot on the MXU's int8 path (~2x the bf16 rate) with EXACT int32
+   accumulation; the grower rescales to f32 once per tile, at split-gain
+   time (models/grower.py quant8). ``Config.quantized_grad`` turns this
+   into an end-to-end training mode: int8 grad/hess with stochastic
+   rounding, following the XGBoost-GPU recipe (arXiv:1706.08359 §5).
+
+Two float precision modes share the same kernel body (``mode``):
+
+- "hilo" (the fast default): the RHS is split into [hi || lo] bf16 halves
+  of the f32 channels IN KERNEL; both halves' products accumulate in f32 on
+  the MXU, so the recombined sum carries ~16-17 mantissa bits of input
+  precision (~2^-17 relative rounding) with exact counts — comparable to
+  (slightly coarser than) the reference GPU's float32 histograms
+  (gpu_use_dp=false, docs/GPU-Performance.rst:133-140), at 2 bf16 MXU
+  passes.
+- "highest": f32 RHS contracted at Precision.HIGHEST (6 bf16 passes) — the
+  precise alternative, selected by ``deterministic=true``.
+
+``interpret=True`` runs any kernel through the Pallas interpreter so the
+whole pipeline (including the DMA gather) is testable on CPU hosts
+(``Config.hist_pallas_interpret``); tier-1 parity suites run this way.
 """
 
 from __future__ import annotations
 
 import functools
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -39,96 +65,26 @@ import jax.numpy as jnp
 _PAD = 128          # lane width; P*S channels are padded up to this
 
 
-def _hist_kernel(binsT_ref, rhs_ref, out_ref, *, f, b, c, mode):
-    from jax.experimental import pallas as pl
-
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    rhs = rhs_ref[...]     # [C, 2*PAD] bf16 | [C, PAD] f32 | [C, PAD] int8
-    binsT = binsT_ref[...]                               # [F, C] int8
-    oh_dtype = {"hilo": jnp.bfloat16, "highest": jnp.float32,
-                "q8": jnp.int8}[mode]
-    acc_dtype = jnp.int32 if mode == "q8" else jnp.float32
-    prec = jax.lax.Precision.HIGHEST if mode == "highest" else None
-    # Feature packing: with b <= 64 bins a single feature's one-hot fills
-    # only b of the MXU's 128 output rows, so the matmul runs at b/128
-    # utilization. Pack g = 128//b features side by side into one
-    # [C, g*b] one-hot (disjoint lane ranges, so a plain sum builds the
-    # OR) — the max_bin=63 configuration then drives full 128-row MXU
-    # tiles instead of half-empty ones.
-    g = max(1, _PAD // b) if b <= _PAD else 1
-    for j0 in range(0, f, g):                            # static unroll
-        m = min(g, f - j0)
-        iota = jax.lax.broadcasted_iota(jnp.int32, (c, m * b), 1)
-        oh = None
-        for k in range(m):
-            col = binsT[j0 + k, :].astype(jnp.int32) + k * b   # [C]
-            hit = (col[:, None] == iota).astype(oh_dtype)      # [C, m*B]
-            oh = hit if oh is None else oh + hit
-        acc = jax.lax.dot_general(
-            oh, rhs, (((0,), (0,)), ((), ())), precision=prec,
-            preferred_element_type=acc_dtype)
-        if mode == "hilo":
-            acc = acc[:, :_PAD] + acc[:, _PAD:]          # recombine halves
-        out_ref[j0 * b:(j0 + m) * b, :] += acc
+def _chan_layout(p: int, s: int):
+    """Static per-lane channel layout: output lane q carries stat channel
+    ``s_of_q[q]`` of tile slot ``p_of_q[q]`` (q < p*s; higher lanes are
+    dead padding). Matches the ``reshape(-1, p*s)`` layout of the XLA
+    formulations so outputs slice/reshape identically."""
+    q = np.arange(_PAD)
+    valid = q < p * s
+    p_of_q = np.where(valid, np.minimum(q // s, p - 1), 0)
+    s_of_q = np.where(valid, q % s, 0)
+    return p_of_q, s_of_q, valid
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "block", "mode"))
-def _hist_pallas_call(binsT, rhs, *, num_bins, block, mode):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-    f, n = binsT.shape
-    c = block
-    nblk = n // c
-    w = 2 * _PAD if mode == "hilo" else _PAD
-    out_dtype = jnp.int32 if mode == "q8" else jnp.float32
-    kernel = functools.partial(_hist_kernel, f=f, b=num_bins, c=c, mode=mode)
-    return pl.pallas_call(
-        kernel,
-        grid=(nblk,),
-        in_specs=[
-            pl.BlockSpec((f, c), lambda i: (0, i)),
-            pl.BlockSpec((c, w), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((f * num_bins, _PAD), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f * num_bins, _PAD), out_dtype),
-        # CompilerParams was TPUCompilerParams before jax 0.5
-        compiler_params=getattr(pltpu, "CompilerParams",
-                                getattr(pltpu, "TPUCompilerParams", None))(
-            dimension_semantics=("arbitrary",),
-            # the default 16M scoped-vmem cap rejects the q8 mode at full
-            # Higgs scale (measured 2026-07-30: int8 accumulation needed a
-            # 28.31M stack allocation at block=2048, F=28, B=255); the
-            # kernel's working set is still far below the 128M physical
-            # VMEM, so raise the cap rather than shrink the block
-            vmem_limit_bytes=100 * 1024 * 1024),
-    )(binsT, rhs)
-
-
-def _prep_rhs(binsT, stats, leaf_ids, sel, block, q8=False):
-    """Shared prep: pad rows to the block size and build the leaf-onehot x
-    stat channel matrix [N, _PAD] (f32, or int8 for the q8 mode)."""
-    f, n = binsT.shape
+def chan_leaf_table(sel: jax.Array, s: int) -> jax.Array:
+    """[1, _PAD] int32: the leaf id each output lane accumulates, or -9 for
+    dead lanes. Built in XLA from the tile selection ``sel`` (tiny — P
+    int32 values), consumed whole by every grid step."""
     p = sel.shape[0]
-    s = stats.shape[1]
-    assert p * s <= _PAD, (p, s)
-    c = min(block, max(512, -(-n // 512) * 512))
-    pad = -n % c
-    if pad:
-        binsT = jnp.pad(binsT, ((0, 0), (0, pad)))
-        stats = jnp.pad(stats, ((0, pad), (0, 0)))
-        leaf_ids = jnp.pad(leaf_ids, (0, pad), constant_values=-1)
-    lo = leaf_ids[:, None] == sel[None, :]                         # [N, P]
-    if q8:
-        rhs = jnp.where(lo[:, :, None], stats[:, None, :],
-                        jnp.int8(0)).reshape(-1, p * s)
-    else:
-        rhs = (lo.astype(jnp.float32)[:, :, None]
-               * stats.astype(jnp.float32)[:, None, :]).reshape(-1, p * s)
-    rhs = jnp.pad(rhs, ((0, 0), (0, _PAD - p * s)))
-    return binsT, rhs, c
+    p_of_q, _, valid = _chan_layout(p, s)
+    return jnp.where(jnp.asarray(valid),
+                     sel[jnp.asarray(p_of_q)], jnp.int32(-9))[None, :]
 
 
 def split_hilo(rhs: jax.Array) -> jax.Array:
@@ -139,8 +95,235 @@ def split_hilo(rhs: jax.Array) -> jax.Array:
     return jnp.concatenate([rhs_hi, rhs_lo], axis=1)
 
 
+def _accumulate(binsT_blk, leaf_blk, stats_blk, chan_leaf, vmask, out_ref,
+                *, f, b, c, s, mode):
+    """Shared fused compute body: build the leaf-channel RHS and the packed
+    bin one-hot for one row block entirely in VMEM and contract on the MXU.
+
+    binsT_blk: [F, C] int8 bin columns for this block's rows.
+    leaf_blk:  [C] int32 leaf slot per row.
+    stats_blk: [C, S] f32 (or int8 for q8) per-row statistics.
+    chan_leaf: [_PAD] int32 leaf id per output lane (-9 = dead lane).
+    vmask:     [C] bool row validity (gather padding) or None.
+    """
+    # --- leaf-channel RHS [C, _PAD]: lane q carries stats[:, q mod S]
+    # where the row's leaf id matches the lane's slot, else 0. The layout
+    # is periodic, so the expansion is a static tile+slice (no gather, no
+    # captured index constants — both would fail kernel tracing).
+    reps = -(-_PAD // max(s, 1))
+    stat_chan = jnp.concatenate([stats_blk] * reps, axis=1)[:, :_PAD]
+    # lanes q >= P*S carry garbage stat values here; their chan_leaf is -9
+    # so ``match`` zeroes them below
+    match = leaf_blk[:, None] == chan_leaf[None, :]          # [C, _PAD]
+    if vmask is not None:
+        match = match & vmask[:, None]
+    oh_dtype = {"hilo": jnp.bfloat16, "highest": jnp.float32,
+                "q8": jnp.int8}[mode]
+    acc_dtype = jnp.int32 if mode == "q8" else jnp.float32
+    prec = jax.lax.Precision.HIGHEST if mode == "highest" else None
+    if mode == "q8":
+        rhs = jnp.where(match, stat_chan, jnp.int8(0))
+    else:
+        rhs = jnp.where(match, stat_chan.astype(jnp.float32),
+                        jnp.float32(0.0))
+        if mode == "hilo":
+            rhs = split_hilo(rhs)                            # [C, 2*_PAD]
+    # Feature packing: with b <= 64 bins a single feature's one-hot fills
+    # only b of the MXU's 128 output rows, so the matmul runs at b/128
+    # utilization. Pack g = 128//b features side by side into one
+    # [C, g*b] one-hot (disjoint lane ranges, so a plain sum builds the
+    # OR) — the max_bin=63 configuration then drives full 128-row MXU
+    # tiles instead of half-empty ones.
+    g = max(1, _PAD // b) if b <= _PAD else 1
+    for j0 in range(0, f, g):                                # static unroll
+        m = min(g, f - j0)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (c, m * b), 1)
+        oh = None
+        for k in range(m):
+            col = binsT_blk[j0 + k, :].astype(jnp.int32) + k * b     # [C]
+            hit = (col[:, None] == iota).astype(oh_dtype)            # [C, m*B]
+            oh = hit if oh is None else oh + hit
+        acc = jax.lax.dot_general(
+            oh, rhs, (((0,), (0,)), ((), ())), precision=prec,
+            preferred_element_type=acc_dtype)
+        if mode == "hilo":
+            acc = acc[:, :_PAD] + acc[:, _PAD:]              # recombine
+        out_ref[j0 * b:(j0 + m) * b, :] += acc
+
+
+def _fused_kernel(binsT_ref, leaf_ref, stats_ref, chan_ref, out_ref,
+                  *, f, b, c, s, mode):
+    """Full-pass fused kernel: leaf channels built in kernel, rows streamed
+    block-by-block straight from the bin matrix (fusion 1)."""
+    from jax.experimental import pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    _accumulate(binsT_ref[...], leaf_ref[0, :], stats_ref[...],
+                chan_ref[0, :], None, out_ref, f=f, b=b, c=c, s=s, mode=mode)
+
+
+def _gather_kernel(idx_ref, binsT_hbm, leaf_hbm, stats_hbm, idxv_ref,
+                   chan_ref, out_ref, bins_s, leaf_s, stats_s,
+                   sem_b, sem_l, sem_s, *, f, b, c, s, mode, n):
+    """Compacted-pass fused kernel (fusion 2): the grid step DMAs the
+    pending rows' bin columns, leaf ids and stats from the HBM-resident
+    FULL arrays into VMEM scratch using the scalar-prefetched row-index
+    buffer, then runs the same compute body. The compacted ``[F, N/r]``
+    copy the XLA ladder used to write/re-read is never materialized.
+
+    Per-row DMA is latency-bound, not bandwidth-bound — the three copy
+    streams (bins column, stats row, leaf id) are issued back-to-back for
+    the whole block before the first wait, so the DMA engines pipeline
+    across rows. ``idx`` entries >= n are ladder padding: their source is
+    clamped to row n-1 and the row is masked out of the leaf match."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    def _copies(k):
+        j = jnp.minimum(idx_ref[i * c + k], n - 1)
+        return (
+            pltpu.make_async_copy(binsT_hbm.at[:, pl.ds(j, 1)],
+                                  bins_s.at[:, pl.ds(k, 1)], sem_b),
+            pltpu.make_async_copy(leaf_hbm.at[:, pl.ds(j, 1)],
+                                  leaf_s.at[:, pl.ds(k, 1)], sem_l),
+            pltpu.make_async_copy(stats_hbm.at[pl.ds(j, 1), :],
+                                  stats_s.at[pl.ds(k, 1), :], sem_s),
+        )
+
+    def start(k, _):
+        for dma in _copies(k):
+            dma.start()
+        return 0
+
+    jax.lax.fori_loop(0, c, start, 0)
+
+    def wait(k, _):
+        # same src/dst shapes as the started copies -> same byte counts,
+        # so c waits per stream drain exactly the c started copies
+        for dma in _copies(0):
+            dma.wait()
+        return 0
+
+    jax.lax.fori_loop(0, c, wait, 0)
+
+    vmask = idxv_ref[0, :] < n
+    _accumulate(bins_s[...], leaf_s[0, :], stats_s[...], chan_ref[0, :],
+                vmask, out_ref, f=f, b=b, c=c, s=s, mode=mode)
+
+
+def _compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+    # CompilerParams was TPUCompilerParams before jax 0.5
+    cls = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+    return cls(
+        dimension_semantics=("arbitrary",),
+        # the default 16M scoped-vmem cap rejects the q8 mode at full
+        # Higgs scale (measured 2026-07-30: int8 accumulation needed a
+        # 28.31M stack allocation at block=2048, F=28, B=255); the
+        # kernel's working set is still far below the 128M physical
+        # VMEM, so raise the cap rather than shrink the block
+        vmem_limit_bytes=100 * 1024 * 1024)
+
+
+def _out_spec(f, num_bins, mode):
+    out_dtype = jnp.int32 if mode == "q8" else jnp.float32
+    return jax.ShapeDtypeStruct((f * num_bins, _PAD), out_dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block", "mode", "interpret"))
+def _fused_call(binsT, leaf2d, stats, chan, *, num_bins, block, mode,
+                interpret=False):
+    """Full-pass launch: N must be padded to a ``block`` multiple (pad leaf
+    ids with -2 so padding matches no lane)."""
+    from jax.experimental import pallas as pl
+    f, n = binsT.shape
+    s = stats.shape[1]
+    c = block
+    nblk = n // c
+    kernel = functools.partial(_fused_kernel, f=f, b=num_bins, c=c, s=s,
+                               mode=mode)
+    kw = ({"interpret": True} if interpret
+          else {"compiler_params": _compiler_params()})
+    return pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((f, c), lambda i: (0, i)),
+            pl.BlockSpec((1, c), lambda i: (0, i)),
+            pl.BlockSpec((c, s), lambda i: (i, 0)),
+            pl.BlockSpec((1, _PAD), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((f * num_bins, _PAD), lambda i: (0, 0)),
+        out_shape=_out_spec(f, num_bins, mode),
+        **kw,
+    )(binsT, leaf2d, stats, chan)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block", "mode", "interpret"))
+def _fused_gather_call(idx, binsT, leaf2d, stats, idx2d, chan, *, num_bins,
+                       block, mode, interpret=False):
+    """Compacted-pass launch: ``idx`` [M] (M a ``block`` multiple, padded
+    with n) indexes rows of the FULL binsT/leaf/stats, which stay HBM
+    resident (memory_space ANY) and are gathered in kernel."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    f, n = binsT.shape
+    s = stats.shape[1]
+    m = idx.shape[0]
+    c = block
+    nblk = m // c
+    kernel = functools.partial(_gather_kernel, f=f, b=num_bins, c=c, s=s,
+                               mode=mode, n=n)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),            # binsT [F, N]
+            pl.BlockSpec(memory_space=pltpu.ANY),            # leaf  [1, N]
+            pl.BlockSpec(memory_space=pltpu.ANY),            # stats [N, S]
+            pl.BlockSpec((1, c), lambda i, idx_ref: (0, i)),  # idx2d
+            pl.BlockSpec((1, _PAD), lambda i, idx_ref: (0, 0)),  # chan
+        ],
+        out_specs=pl.BlockSpec((f * num_bins, _PAD),
+                               lambda i, idx_ref: (0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((f, c), binsT.dtype),
+            pltpu.VMEM((1, c), jnp.int32),
+            pltpu.VMEM((c, s), stats.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    kw = ({"interpret": True} if interpret
+          else {"compiler_params": _compiler_params()})
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_out_spec(f, num_bins, mode),
+        **kw,
+    )(idx, binsT, leaf2d, stats, idx2d, chan)
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
 def histogram_tiles_pallas_mode(binsT, stats, leaf_ids, sel, num_bins,
-                                block=2048, mode="hilo"):
+                                block=2048, mode="hilo", idx=None,
+                                interpret=False):
     """[P, F, B, S] histogram tile via the fused kernel.
 
     ``mode``: "hilo" (2-pass bf16, the fast f32 default), "highest"
@@ -148,26 +331,56 @@ def histogram_tiles_pallas_mode(binsT, stats, leaf_ids, sel, num_bins,
     the quantized-gradient training mode; ~2x hilo's MXU rate).
     Takes the FEATURE-MAJOR bin matrix [F, N].
 
-    The grid is ``ceil(N / block)`` row steps, so the grower's row
-    compaction (ops/histogram.py compact_rows) shrinks the kernel's grid
-    in proportion to the ladder rung: a [F, N/8] compacted buffer runs an
-    8x smaller grid than the full pass, same per-step working set.
+    ``idx``: optional [M] int32 compacted row-index buffer (the compaction
+    ladder's output, ops/histogram.py compact_indices; entries >= N are
+    padding). When given, the GATHER form of the kernel runs: binsT/stats/
+    leaf_ids stay HBM resident and only the indexed rows are DMA'd into
+    VMEM inside the grid step — the grid is ``ceil(M / block)`` instead of
+    ``ceil(N / block)`` and no compacted copy is materialized. Without it
+    the full-pass form streams all N rows (the grower picks idx via its
+    ladder dispatch, so every rung compiles once).
+
+    ``interpret=True`` runs the kernel through the Pallas interpreter
+    (CPU-testable; Config.hist_pallas_interpret).
     """
-    f = binsT.shape[0]
+    f, n = binsT.shape
     p = sel.shape[0]
     s = stats.shape[1]
-    binsT, rhs, c = _prep_rhs(binsT, stats, leaf_ids, sel, block,
-                              q8=(mode == "q8"))
-    if mode == "hilo":
-        rhs = split_hilo(rhs)
-    out = _hist_pallas_call(binsT, rhs, num_bins=num_bins, block=c,
-                            mode=mode)
+    assert p * s <= _PAD, (p, s)
+    chan = chan_leaf_table(sel, s)
+    leaf2d = leaf_ids[None, :].astype(jnp.int32)
+    if mode != "q8":
+        stats = stats.astype(jnp.float32)
+    if idx is not None:
+        c = min(block, max(128, _round_up(idx.shape[0], 128)))
+        mpad = _round_up(idx.shape[0], c)
+        idx = idx.astype(jnp.int32)
+        if mpad != idx.shape[0]:
+            idx = jnp.pad(idx, (0, mpad - idx.shape[0]),
+                          constant_values=n)
+        out = _fused_gather_call(idx, binsT, leaf2d, stats, idx[None, :],
+                                 chan, num_bins=num_bins, block=c,
+                                 mode=mode, interpret=interpret)
+    else:
+        c = min(block, max(512, _round_up(n, 512)))
+        pad = _round_up(n, c) - n
+        if pad:
+            # loop-invariant: XLA hoists these pads out of the grower's
+            # while_loop, so the padded copies are built once per program,
+            # not once per pass
+            binsT = jnp.pad(binsT, ((0, 0), (0, pad)))
+            stats = jnp.pad(stats, ((0, pad), (0, 0)))
+            leaf2d = jnp.pad(leaf2d, ((0, 0), (0, pad)),
+                             constant_values=-2)
+        out = _fused_call(binsT, leaf2d, stats, chan, num_bins=num_bins,
+                          block=c, mode=mode, interpret=interpret)
     return out[:, :p * s].reshape(f, num_bins, p, s).transpose(2, 0, 1, 3)
 
 
 def histogram_tiles_pallas(binsT: jax.Array, stats: jax.Array,
                            leaf_ids: jax.Array, sel: jax.Array,
-                           num_bins: int, block: int = 2048) -> jax.Array:
+                           num_bins: int, block: int = 2048,
+                           idx=None, interpret: bool = False) -> jax.Array:
     """[P, F, B, S] histogram tile via the fused kernel, HIGHEST precision.
 
     Args mirror histogram.py histogram_tiles but take the FEATURE-MAJOR bin
@@ -175,13 +388,138 @@ def histogram_tiles_pallas(binsT: jax.Array, stats: jax.Array,
     loads).
     """
     return histogram_tiles_pallas_mode(binsT, stats, leaf_ids, sel,
-                                       num_bins, block, mode="highest")
+                                       num_bins, block, mode="highest",
+                                       idx=idx, interpret=interpret)
 
 
 def histogram_tiles_pallas_hilo(binsT: jax.Array, stats: jax.Array,
                                 leaf_ids: jax.Array, sel: jax.Array,
-                                num_bins: int, block: int = 2048) -> jax.Array:
+                                num_bins: int, block: int = 2048,
+                                idx=None, interpret: bool = False
+                                ) -> jax.Array:
     """[P, F, B, S] histogram tile via the fused kernel, hi/lo bf16 matmuls
     (the fast default — see the module docstring's precision model)."""
     return histogram_tiles_pallas_mode(binsT, stats, leaf_ids, sel,
-                                       num_bins, block, mode="hilo")
+                                       num_bins, block, mode="hilo",
+                                       idx=idx, interpret=interpret)
+
+
+# ---------------------------------------------------------------- roofline
+
+# MXU input-rate multiplier per mode: passes over the same one-hot x rhs
+# contraction (hilo = 2 bf16 passes, highest = 6, q8 = 1 int8 pass)
+MXU_PASSES = {"hilo": 2, "highest": 6, "q8": 1}
+
+
+def traffic_model(n, f, b, p, s, mode="hilo", gathered_rows=None):
+    """Modeled HBM bytes per histogram tile pass: the fused kernel vs the
+    XLA one-hot formulation of the same contraction (which must
+    materialize its one-hot and leaf-channel RHS through HBM — each
+    counted write+read) vs the pre-fusion kernel (XLA-side [N, 128] RHS +
+    compacted-copy gather). Used by the acceptance/traffic tests and
+    scripts/kernel_bench.py; all quantities are static byte counts.
+
+    ``gathered_rows``: rows the compaction ladder selected (the gather
+    kernel's M); None = full pass over n rows.
+    """
+    stat_b = 1 if mode == "q8" else 4
+    out_b = 4
+    rhs_b = 1 if mode == "q8" else (2 * 2 if mode == "hilo" else 4)
+    oh_b = 1 if mode == "q8" else (2 if mode == "hilo" else 4)
+    m = n if gathered_rows is None else gathered_rows
+    out_bytes = f * b * _PAD * out_b
+    common = m * f + m * s * stat_b + m * 4          # bins + stats + leaf
+    fused = common + out_bytes + (m * 4 if gathered_rows is not None else 0)
+    # pre-fusion kernel: [N(=m), 128] RHS written by XLA then re-read by
+    # the kernel, plus (when compacted) the [F, M] gathered copy written
+    # then re-read
+    prefusion = (common + out_bytes + 2 * m * _PAD * rhs_b
+                 + (2 * m * f if gathered_rows is not None else 0))
+    # XLA one-hot contraction: the [M, F*B] one-hot and the RHS both
+    # round-trip HBM (XLA cannot keep either resident across the scan)
+    xla_onehot = (common + out_bytes + 2 * m * f * b * oh_b
+                  + 2 * m * _PAD * rhs_b)
+    return {"fused": fused, "prefusion": prefusion,
+            "xla_onehot": xla_onehot, "output": out_bytes}
+
+
+# ------------------------------------------------------------- autotuning
+
+# measured (block, tile_leaves) per shape bucket — keyed like the predict
+# engine's compile cache: (F, B, log2-row-bucket, mode)
+_tuned: dict = {}
+
+BLOCK_CANDIDATES = (1024, 2048, 4096, 8192)
+
+
+def structural_tile_leaves(stats_channels: int = 3) -> int:
+    """The leaf batch the kernel wants, by construction: the widest tile
+    whose (leaf x stat) channels fit one 128-lane group. No measurement
+    needed — kernel cost is flat in the tile width (channels occupy the
+    full lane group either way)."""
+    return max(1, _PAD // max(stats_channels, 1))
+
+
+def autotune_hist(binsT, num_bins: int, mode: str = "hilo",
+                  stats_channels: int = 3, sample_rows: int = 262144,
+                  block_candidates=BLOCK_CANDIDATES,
+                  force_measure: bool = False) -> dict:
+    """Measured kernel-shape tuning, keyed like the predict engine's shape
+    buckets: TIME the fused kernel at each candidate row-block size on a
+    sampled prefix and cache the winner per (F, B, log2-row-bucket, mode).
+
+    The leaf batch (``tile_leaves``) is chosen structurally: the kernel's
+    cost is flat in the tile width (channels occupy fixed 128 lanes), so
+    the widest tile that fits the lane group — ``128 // S`` — always wins;
+    it is returned alongside so the grower issues the fewest passes.
+
+    Non-TPU backends return the static defaults without measuring
+    (``force_measure`` overrides for tests, running in interpret mode).
+    Returns ``{"block": int, "tile_leaves": int}`` (0 = keep defaults).
+    """
+    import time
+
+    tile = structural_tile_leaves(stats_channels)
+    if jax.default_backend() != "tpu" and not force_measure:
+        return {"block": 0, "tile_leaves": 0}
+    f, n = binsT.shape
+    key = (f, int(num_bins), max(n, 1).bit_length(), mode)
+    hit = _tuned.get(key)
+    if hit is not None:
+        return hit
+    interpret = jax.default_backend() != "tpu"
+    k = min(n, sample_rows)
+    subT = binsT[:, :k]
+    st_dtype = jnp.int8 if mode == "q8" else jnp.float32
+    stats = jnp.ones((k, stats_channels), st_dtype)
+    lid = jnp.zeros((k,), jnp.int32)
+    sel = jnp.zeros((tile,), jnp.int32).at[1:].set(-1)
+    times = {}
+    for blk in block_candidates:
+        if blk > _round_up(k, 512):
+            continue
+        try:
+            fn = functools.partial(
+                histogram_tiles_pallas_mode, num_bins=num_bins, block=blk,
+                mode=mode, interpret=interpret)
+            r = fn(subT, stats, lid, sel)
+            jnp.sum(r).block_until_ready()       # compile + first run
+            t0 = time.time()
+            r = fn(subT, stats, lid, sel)
+            float(jnp.sum(r))                    # sync via scalar fetch
+            times[blk] = time.time() - t0
+        except Exception:                        # candidate unsupported
+            continue
+    if not times:
+        out = {"block": 0, "tile_leaves": tile}
+    else:
+        best = min(times, key=times.get)
+        from ..utils import log
+        log.info("pallas hist autotune: "
+                 + ", ".join(f"blk{b_}={t * 1e3:.1f}ms"
+                             for b_, t in sorted(times.items()))
+                 + f" -> block={best} tile_leaves={tile} "
+                 f"(at {k} sampled rows, mode={mode})")
+        out = {"block": best, "tile_leaves": tile}
+    _tuned[key] = out
+    return out

@@ -1,0 +1,176 @@
+"""Per-kernel roofline microbench for the fused Pallas histogram pipeline.
+
+For each mode (hilo / highest / q8) and kernel form (full pass / in-kernel
+gather) at a Higgs-shaped tile pass, reports:
+
+- **bytes moved** (modeled HBM traffic, ops/pallas_hist.py traffic_model)
+  and the achieved HBM bandwidth implied by the measured time;
+- **MXU passes** (contraction input passes: hilo 2 bf16, highest 6, q8 1
+  int8) and the achieved vs peak MXU rate on the mode's input path;
+- the **XLA onehot** formulation of the same contraction as the baseline
+  (the acceptance comparison: the fused kernel's modeled traffic is
+  >= 5x below it, and on TPU the measured time should follow).
+
+On a TPU the numbers are real; on CPU hosts ``--interpret`` runs the
+kernels through the Pallas interpreter — times are then meaningless
+(interpretation overhead), but the traffic/roofline MODEL columns still
+hold and every kernel variant actually executes. The CI smoke
+(`tests/run_suite.sh`, ``--fast --interpret``) runs all nine variants
+through the interpreter at a tiny shape (~30-60 s) and asserts the
+modeled >=5x traffic ratios; ``--model-only`` skips execution entirely
+for an instant model-table print.
+
+Usage:
+  python scripts/kernel_bench.py                  # Higgs0.5M shape, TPU
+  python scripts/kernel_bench.py --rows 10500000  # full Higgs
+  python scripts/kernel_bench.py --fast --interpret   # the CI smoke
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# v5e peaks per MXU input path (same assumptions as bench.py)
+PEAK = {"f32": 98e12, "bf16": 197e12, "int8": 394e12}
+MODE_PATH = {"hilo": "bf16", "highest": "bf16", "q8": "int8"}
+# ~819 GB/s HBM per v5e chip
+PEAK_HBM = 819e9
+
+
+def timeit(fn, reps):
+    import jax.numpy as jnp
+    r = fn()
+    float(jnp.sum(r))               # compile + first run
+    t0 = time.time()
+    for _ in range(reps):
+        r = fn()
+    float(jnp.sum(r))               # sync via scalar fetch
+    return (time.time() - t0) / reps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=500_000,
+                    help="tile-pass rows (default: the Higgs0.5M shape)")
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--bins", type=int, default=255)
+    ap.add_argument("--tile", type=int, default=42)
+    ap.add_argument("--block", type=int, default=2048)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--gather-frac", type=float, default=0.25,
+                    help="pending-row fraction for the gather-kernel rows")
+    ap.add_argument("--modes", type=str, default="hilo,highest,q8")
+    ap.add_argument("--interpret", action="store_true",
+                    help="run kernels through the Pallas interpreter "
+                         "(CPU hosts; times are interpreter overhead)")
+    ap.add_argument("--model-only", action="store_true",
+                    help="print the traffic/roofline model without timing "
+                         "(works anywhere, instantly)")
+    ap.add_argument("--fast", action="store_true",
+                    help="CI smoke knobs: tiny shape, 1 rep")
+    args = ap.parse_args()
+    if args.fast:
+        args.rows = min(args.rows, 8192)
+        args.features = min(args.features, 6)
+        args.bins = min(args.bins, 63)
+        args.block = min(args.block, 512)
+        args.reps = 1
+
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops import pallas_hist
+    from lightgbm_tpu.ops.histogram import histogram_tiles
+
+    n, f, b, p = args.rows, args.features, args.bins, args.tile
+    s = 3
+    m = -(-int(n * args.gather_frac) // 128) * 128
+    backend = jax.default_backend()
+    interpret = args.interpret and backend != "tpu"
+    print(f"# device={jax.devices()[0]} N={n} F={f} B={b} P={p} "
+          f"block={args.block} gather_rows={m} interpret={interpret}",
+          file=sys.stderr)
+
+    rng = np.random.RandomState(0)
+    binsT_np = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    binsT = jnp.asarray(binsT_np)
+    bins = jnp.asarray(np.ascontiguousarray(binsT_np.T))
+    stats_f = jnp.asarray(rng.normal(size=(n, s)).astype(np.float32))
+    stats_i = jnp.asarray(rng.randint(-127, 128, (n, s)).astype(np.int8))
+    leaf = jnp.asarray(rng.randint(0, p, size=n).astype(np.int32))
+    sel = jnp.asarray(np.arange(p, dtype=np.int32))
+    idx = jnp.asarray(np.sort(rng.choice(n, size=m, replace=False))
+                      .astype(np.int32))
+
+    # per-pass MAC count of the contraction: every row drives F one-hot
+    # columns x 128 output lanes (feature packing keeps the tile full at
+    # b <= 64, so lanes-per-row is 128 regardless of b)
+    g = max(1, 128 // b) if b <= 128 else 1
+    macs_full = n * (-(-f // g)) * max(b * g, 128) * 128
+    macs_gather = m * (-(-f // g)) * max(b * g, 128) * 128
+
+    rows = []
+
+    def record(name, mode, kind, sec, traffic, macs):
+        path = MODE_PATH.get(mode, "f32")
+        passes = pallas_hist.MXU_PASSES.get(mode, 1)
+        entry = {
+            "variant": name, "mode": mode, "kind": kind,
+            "modeled_bytes": traffic,
+            "mxu_passes": passes,
+            "macs": macs,
+            "sec": round(sec, 6) if sec is not None else None,
+        }
+        if sec is not None and not interpret:
+            entry["achieved_hbm_frac"] = round(traffic / sec / PEAK_HBM, 4)
+            entry["achieved_mxu_frac"] = round(
+                2.0 * macs * passes / sec / PEAK[path], 4)
+        rows.append(entry)
+        print(json.dumps(entry), flush=True)
+
+    for mode in args.modes.split(","):
+        st = stats_i if mode == "q8" else stats_f
+        t = pallas_hist.traffic_model(n, f, b, p, s, mode)
+        tg = pallas_hist.traffic_model(n, f, b, p, s, mode,
+                                       gathered_rows=m)
+        sec_full = sec_gather = sec_xla = None
+        if not args.model_only:
+            sec_full = timeit(lambda: pallas_hist.histogram_tiles_pallas_mode(
+                binsT, st, leaf, sel, b, block=args.block, mode=mode,
+                interpret=interpret), args.reps)
+            sec_gather = timeit(
+                lambda: pallas_hist.histogram_tiles_pallas_mode(
+                    binsT, st, leaf, sel, b, block=args.block, mode=mode,
+                    idx=idx, interpret=interpret), args.reps)
+            xla_m = {"hilo": "onehot_hilo", "highest": "onehot",
+                     "q8": "onehot_q8"}[mode]
+            sec_xla = timeit(lambda: histogram_tiles(
+                bins, st, leaf, sel, b, method=xla_m,
+                block=args.block), args.reps)
+        record(f"pallas_{mode}", mode, "full", sec_full, t["fused"],
+               macs_full)
+        record(f"pallas_{mode}_gather", mode, "gather", sec_gather,
+               tg["fused"], macs_gather)
+        record(f"xla_onehot_{mode}", mode, "xla-baseline", sec_xla,
+               t["xla_onehot"], macs_full)
+        ratio = t["xla_onehot"] / t["fused"]
+        print(f"# {mode}: modeled traffic fused={t['fused']/1e6:.1f}MB "
+              f"xla={t['xla_onehot']/1e6:.1f}MB ratio={ratio:.0f}x "
+              f"(acceptance floor: 5x)", file=sys.stderr)
+        assert ratio >= 5, (mode, ratio)
+        if sec_full is not None and sec_xla is not None and not interpret:
+            print(f"# {mode}: measured fused={sec_full*1e3:.2f}ms "
+                  f"xla={sec_xla*1e3:.2f}ms "
+                  f"speedup={sec_xla/max(sec_full,1e-12):.2f}x",
+                  file=sys.stderr)
+
+    print(f"# OK: {len(rows)} variants", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -14,4 +14,10 @@ done
 # completes (the shrink recorded in the SupervisorReport)
 echo "=== scripts/supervisor_smoke.py"
 python -u "$(dirname "$0")/../scripts/supervisor_smoke.py" || fail=1
+# Pallas histogram-kernel roofline smoke (fast knobs, ~30 s on CPU): runs
+# all three modes x {full, in-kernel gather} through the interpreter at a
+# tiny shape and asserts the modeled fused-vs-XLA traffic ratio >= 5x
+echo "=== scripts/kernel_bench.py"
+python -u "$(dirname "$0")/../scripts/kernel_bench.py" --fast --interpret \
+  || fail=1
 exit $fail

@@ -1,0 +1,431 @@
+"""Pallas-first histogram pipeline: parity, traffic accounting, and the
+quantized-gradient training mode (ops/pallas_hist.py, the primary TPU path).
+
+Kernel-level checks run the REAL kernels through the Pallas interpreter
+(``interpret=True``) so the fused leaf-channel build and the in-kernel DMA
+row gather are exercised on CPU hosts; end-to-end checks train through
+``hist_pallas_interpret=true``. Precision contracts under test:
+
+- "highest": bit-exact vs the scatter reference whenever the sums are
+  exactly representable (the claim a matmul formulation can actually make;
+  with full-mantissa inputs the difference is f32 accumulation-order
+  rounding, bounded here at the prediction level) — and bit-exact model
+  TEXT vs the XLA onehot formulation of the same contraction end to end.
+- "hilo": ~2^-17 relative input rounding (documented bound), counts exact.
+- "q8": exact int32 accumulation — integer equality vs a numpy reference.
+
+The ``pallas`` marker selects this suite; the TPU compile checks skip
+off-TPU (run ``-m pallas`` on a TPU host to cover them).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops import pallas_hist
+from lightgbm_tpu.ops.histogram import (compact_indices, histogram_tiles,
+                                        resolve_method)
+
+pytestmark = pytest.mark.pallas
+
+
+def _mk(n, f, b, n_leaves=12, seed=0, representable=False, int8=False):
+    """Synthetic tile-pass inputs. ``representable=True`` draws stats as
+    multiples of 2^-10 with |sum| << 2^14, so every partial sum is exactly
+    representable in f32 and ANY accumulation grouping gives the same
+    bits — the precondition for the highest-mode bit-exactness claim."""
+    rng = np.random.RandomState(seed)
+    binsT = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    if int8:
+        stats = rng.randint(-127, 128, size=(n, 3)).astype(np.int8)
+    elif representable:
+        stats = (rng.randint(-1023, 1024, size=(n, 3)) / 1024.0
+                 ).astype(np.float32)
+        stats[:, 2] = 1.0
+    else:
+        stats = rng.randn(n, 3).astype(np.float32)
+        stats[:, 2] = 1.0
+    leaf = rng.randint(0, n_leaves, n).astype(np.int32)
+    sel = np.array([0, 2, 5, 7, 9, 11, -1, -1], np.int32)
+    return (jnp.asarray(binsT),
+            jnp.asarray(np.ascontiguousarray(binsT.T)),
+            jnp.asarray(stats), jnp.asarray(leaf), jnp.asarray(sel))
+
+
+# adversarial shapes: N not a multiple of the block, F not a multiple of
+# the bin-packing group (63 bins -> g=2), bins at both production settings
+SHAPES = [
+    pytest.param(3001, 5, 63, 512, id="n3001-f5-b63"),
+    pytest.param(2049, 4, 255, 1024, id="n2049-f4-b255"),
+]
+
+
+@pytest.mark.parametrize("n,f,b,blk", SHAPES)
+def test_highest_bit_exact_vs_scatter(n, f, b, blk):
+    """Full-pass fused kernel, HIGHEST mode: bit-exact vs the scatter
+    reference on exactly-representable stats."""
+    binsT, bins, stats, leaf, sel = _mk(n, f, b, representable=True)
+    h = pallas_hist.histogram_tiles_pallas_mode(
+        binsT, stats, leaf, sel, b, block=blk, mode="highest",
+        interpret=True)
+    ref = histogram_tiles(bins, stats, leaf, sel, b, method="scatter")
+    np.testing.assert_array_equal(np.asarray(h), np.asarray(ref))
+
+
+@pytest.mark.parametrize("n,f,b,blk", SHAPES)
+def test_hilo_documented_bound(n, f, b, blk):
+    """Full-pass fused kernel, hilo mode: values within the documented
+    ~2^-17 input-rounding bound (signed-sum cancellation amplifies the
+    relative error on small cells, hence the max-scaled atol); the count
+    channel is exact."""
+    binsT, bins, stats, leaf, sel = _mk(n, f, b, seed=1)
+    h = np.asarray(pallas_hist.histogram_tiles_pallas_mode(
+        binsT, stats, leaf, sel, b, block=blk, mode="hilo", interpret=True))
+    ref = np.asarray(histogram_tiles(bins, stats, leaf, sel, b,
+                                     method="scatter"))
+    np.testing.assert_allclose(h, ref, rtol=1e-3,
+                               atol=1e-3 * np.abs(ref).max())
+    np.testing.assert_array_equal(h[..., 2], ref[..., 2])
+
+
+@pytest.mark.parametrize("n,f,b,blk", SHAPES)
+def test_q8_exact_integer(n, f, b, blk):
+    """Full-pass fused kernel, q8 mode: EXACT int32 accumulation — integer
+    equality vs a numpy int64 reference."""
+    binsT, bins, stats, leaf, sel = _mk(n, f, b, seed=2, int8=True)
+    h = np.asarray(pallas_hist.histogram_tiles_pallas_mode(
+        binsT, stats, leaf, sel, b, block=blk, mode="q8", interpret=True))
+    bins_np, stats_np, leaf_np = (np.asarray(bins), np.asarray(stats),
+                                  np.asarray(leaf))
+    ref = np.zeros((8, f, b, 3), np.int64)
+    for p_i, lv in enumerate(np.asarray(sel)):
+        if lv < 0:
+            continue
+        rows = np.nonzero(leaf_np == lv)[0]
+        for j in range(f):
+            np.add.at(ref[p_i, j], bins_np[rows, j],
+                      stats_np[rows].astype(np.int64))
+    np.testing.assert_array_equal(h.astype(np.int64), ref)
+
+
+@pytest.mark.parametrize("rung", [1, 2, 8])
+@pytest.mark.parametrize("mode", ["highest", "q8"])
+def test_gather_kernel_parity_rungs(rung, mode):
+    """The in-kernel DMA row gather at compaction rungs 1/2/8: bit-exact
+    (highest on representable stats; q8 integer) vs scatter over the same
+    kept rows. The index buffer is built exactly as the grower's ladder
+    builds it (compact_indices: stable order, padded with N)."""
+    n, f, b = 2881, 5, 63
+    binsT, bins, stats, leaf, sel = _mk(
+        n, f, b, seed=3 + rung, representable=(mode == "highest"),
+        int8=(mode == "q8"))
+    # deeper rungs get fewer pending leaves — exactly the grower's regime
+    # (subtraction makes deep tiles small) and it keeps every rung's
+    # kept-row count under its buffer so the rung would really be chosen
+    keep_leaves = {1: [0, 2, 5], 2: [0, 2], 8: [0]}[rung]
+    keep = jnp.asarray(np.isin(np.asarray(leaf), keep_leaves))
+    m = -(-(n // rung) // 64) * 64
+    assert int(jnp.sum(keep)) <= m, "fixture bug: rung must fit kept rows"
+    idx = compact_indices(keep, m)
+    h = np.asarray(pallas_hist.histogram_tiles_pallas_mode(
+        binsT, stats, leaf, sel, b, block=256, mode=mode, idx=idx,
+        interpret=True))
+    zero = jnp.int8(0) if mode == "q8" else jnp.float32(0.0)
+    masked = jnp.where(keep[:, None], stats, zero)
+    ref_m = ("onehot_q8" if mode == "q8" else "scatter")
+    ref = np.asarray(histogram_tiles(bins, masked, leaf, sel, b,
+                                     method=ref_m))
+    n_kept_slots = len(keep_leaves)
+    np.testing.assert_array_equal(h[:n_kept_slots], ref[:n_kept_slots])
+    # slots whose leaves were NOT kept accumulate nothing from kept rows
+    assert np.all(h[n_kept_slots:6] == 0)
+
+
+def test_gather_all_padding_is_zero():
+    """An index buffer of pure padding (idx == N everywhere) must produce
+    an all-zero histogram: padding rows clamp to row N-1 for the DMA but
+    are masked out of the leaf match."""
+    n, f, b = 700, 3, 16
+    binsT, bins, stats, leaf, sel = _mk(n, f, b, seed=9)
+    idx = jnp.full((128,), n, jnp.int32)
+    h = np.asarray(pallas_hist.histogram_tiles_pallas_mode(
+        binsT, stats, leaf, sel, b, block=128, mode="hilo", idx=idx,
+        interpret=True))
+    assert np.all(h == 0)
+
+
+def test_hilo_gather_matches_full():
+    """Gather over an all-rows index buffer == the full pass, bit-for-bit
+    (same block size -> same accumulation grouping)."""
+    n, f, b = 1024, 4, 32
+    binsT, bins, stats, leaf, sel = _mk(n, f, b, seed=5)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    h_g = np.asarray(pallas_hist.histogram_tiles_pallas_mode(
+        binsT, stats, leaf, sel, b, block=256, mode="hilo", idx=idx,
+        interpret=True))
+    h_f = np.asarray(pallas_hist.histogram_tiles_pallas_mode(
+        binsT, stats, leaf, sel, b, block=256, mode="hilo", interpret=True))
+    np.testing.assert_array_equal(h_g, h_f)
+
+
+# ------------------------------------------------------- traffic accounting
+
+def _walk_jaxpr_shapes(jaxpr, skip_primitives=("pallas_call",)):
+    """All intermediate (shape, dtype) pairs produced OUTSIDE the skipped
+    primitives, recursing through scan/cond/while bodies."""
+    out = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in skip_primitives:
+            continue
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                out.append((tuple(aval.shape), np.dtype(aval.dtype).name))
+        for pv in eqn.params.values():
+            inner = getattr(pv, "jaxpr", None)
+            if inner is not None:
+                out.append(_walk_jaxpr_shapes(inner, skip_primitives))
+            if isinstance(pv, (list, tuple)):
+                for item in pv:
+                    inner = getattr(item, "jaxpr", None)
+                    if inner is not None:
+                        out.append(_walk_jaxpr_shapes(inner,
+                                                      skip_primitives))
+    flat = []
+    for item in out:
+        flat.extend(item if isinstance(item, list) else [item])
+    return flat
+
+
+def test_no_rhs_no_compacted_copy_in_jaxpr():
+    """The fusion claims, asserted on the traced program: the Pallas path
+    materializes neither the [N, 128] leaf-channel RHS (fusion 1) nor the
+    compacted [F, M] bin-matrix copy (fusion 2) outside the kernel, while
+    the XLA fallback path — the positive control that the detector works —
+    does build the compacted copy."""
+    n, f, b, m = 2048, 5, 63, 512
+    binsT, bins, stats, leaf, sel = _mk(n, f, b)
+    idx = compact_indices(leaf < 3, m)
+
+    def fused(bins, stats, leaf, sel, binsT, idx):
+        return histogram_tiles(bins, stats, leaf, sel, b,
+                               method="pallas_hilo", binsT=binsT,
+                               gather_idx=idx, block=256, interpret=True)
+
+    shapes = _walk_jaxpr_shapes(
+        jax.make_jaxpr(fused)(bins, stats, leaf, sel, binsT, idx).jaxpr)
+    for shp, dt in shapes:
+        # fusion 1: no [rows, 128] float RHS at any row count
+        assert not (len(shp) == 2 and shp[1] in (128, 256)
+                    and shp[0] >= m and dt in ("float32", "bfloat16")), (
+            f"leaf-channel RHS materialized outside the kernel: {shp} {dt}")
+        # fusion 2: no compacted bin-matrix copy in either orientation
+        assert not (len(shp) == 2 and dt in ("int8", "uint8")
+                    and (shp in ((f, m), (m, f)))), (
+            f"compacted bin copy materialized outside the kernel: {shp}")
+
+    def fallback(bins, stats, leaf, sel, binsT, idx):
+        return histogram_tiles(bins, stats, leaf, sel, b, method="onehot",
+                               binsT=binsT, gather_idx=idx, block=256)
+
+    fb_shapes = _walk_jaxpr_shapes(
+        jax.make_jaxpr(fallback)(bins, stats, leaf, sel, binsT, idx).jaxpr)
+    assert any(len(shp) == 2 and dt in ("int8", "uint8")
+               and shp in ((f, m), (m, f)) for shp, dt in fb_shapes), (
+        "detector broken: the XLA fallback should materialize the "
+        "compacted copy")
+
+
+def test_traffic_model_5x_at_higgs_shape():
+    """Acceptance: modeled post-fusion HBM bytes/pass <= bin matrix +
+    stats + leaf ids + output, and >= 5x below the XLA onehot path at the
+    Higgs0.5M shape (500k x 28 x 255 bins x 42-leaf tile)."""
+    n, f, b, p, s = 500_000, 28, 255, 42, 3
+    for mode in ("hilo", "highest", "q8"):
+        t = pallas_hist.traffic_model(n, f, b, p, s, mode)
+        stat_b = 1 if mode == "q8" else 4
+        budget = n * f + n * s * stat_b + n * 4 + t["output"]
+        assert t["fused"] <= budget, (mode, t)
+        assert t["xla_onehot"] / t["fused"] >= 5, (mode, t)
+        # and the pre-fusion kernel (XLA-side [N,128] RHS) is also beaten
+        assert t["prefusion"] / t["fused"] >= 5, (mode, t)
+
+
+# ------------------------------------------------------------- end to end
+
+def _tree_text(booster):
+    """Model text with the embedded parameter dump stripped (it names the
+    histogram method, which legitimately differs between parity runs)."""
+    return "\n".join(l for l in booster.model_to_string().splitlines()
+                     if not l.startswith("[") and l != "end of parameters")
+
+
+@pytest.fixture(scope="module")
+def e2e_models():
+    """One small well-separated training per backend under comparison —
+    shared across the e2e parity tests so the interpreter cost is paid
+    once. Compaction stays ON (default ladder) so the Pallas run drives
+    the gather kernel inside grow_tree's rung dispatch."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(4)
+    n = 1500
+    X = rng.normal(size=(n, 5))
+    # well-SEPARATED split gains (distinct per-feature step sizes, little
+    # noise) so structure comparisons test the backends, not coin flips
+    # between near-tied noise splits
+    y = (2.0 * (X[:, 0] > 0.3) + 1.0 * (X[:, 1] > -0.2)
+         + 0.5 * (X[:, 2] > 0.5) + 0.01 * rng.normal(size=n))
+    out = {}
+    for name, params in [
+        ("scatter", {"histogram_method": "scatter"}),
+        ("onehot", {"histogram_method": "onehot"}),
+        ("pallas", {"histogram_method": "pallas",
+                    "hist_pallas_interpret": True}),
+        ("pallas_nocompact", {"histogram_method": "pallas",
+                              "hist_pallas_interpret": True,
+                              "hist_compaction": False}),
+    ]:
+        ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+        booster = lgb.train({"objective": "regression", "num_leaves": 8,
+                             "verbosity": -1, **params},
+                            ds, num_boost_round=4)
+        out[name] = (_tree_text(booster), booster.predict(X))
+    return out
+
+
+def test_e2e_text_parity_vs_onehot(e2e_models):
+    """hist_method=pallas (HIGHEST) model text is BIT-IDENTICAL to the XLA
+    onehot formulation end to end — the kernel is a drop-in replacement
+    for its reference formulation, compaction rungs included."""
+    assert e2e_models["pallas"][0] == e2e_models["onehot"][0]
+
+
+def test_e2e_gather_path_is_inert(e2e_models):
+    """Compaction ON (gather kernel inside the ladder) vs OFF (full-pass
+    kernel only): identical split structure, predictions within f32
+    accumulation-order rounding. (Not bit-text: the full pass interleaves
+    the non-tile rows as zero contributions, which lands the kept rows in
+    different SIMD reduction lanes than the compacted pass — the same
+    pass-shape tolerance test_compaction documents for the XLA ladder.)"""
+    def structure(text):
+        return [l for l in text.splitlines()
+                if l.startswith(("split_feature", "threshold"))]
+    assert structure(e2e_models["pallas"][0]) == \
+        structure(e2e_models["pallas_nocompact"][0])
+    np.testing.assert_allclose(e2e_models["pallas"][1],
+                               e2e_models["pallas_nocompact"][1],
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_e2e_structure_parity_vs_scatter(e2e_models):
+    """vs the scatter reference: identical split structure (features +
+    thresholds), predictions within f32 accumulation-order rounding (the
+    matmul formulations regroup partial sums; same bound test_compaction
+    documents for the onehot backend)."""
+    def structure(text):
+        return [l for l in text.splitlines()
+                if l.startswith(("split_feature", "threshold",
+                                 "decision_type", "left_child",
+                                 "right_child", "num_leaves"))]
+    assert structure(e2e_models["pallas"][0]) == \
+        structure(e2e_models["scatter"][0])
+    np.testing.assert_allclose(e2e_models["pallas"][1],
+                               e2e_models["scatter"][1],
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_quantized_grad_resolution():
+    """Config.quantized_grad maps every method family onto its q8 twin:
+    the Pallas kernel wherever kernels run (TPU, or interpret for tests),
+    the XLA int8 contraction elsewhere — never silently non-quantized."""
+    on_cpu = jax.default_backend() != "tpu"
+    want_plain = "onehot_q8" if on_cpu else "pallas_q8"
+    assert resolve_method("auto", quantized=True) == want_plain
+    assert resolve_method("auto", quantized=True,
+                          interpret=True) == "pallas_q8"
+    assert resolve_method("pallas_hilo", quantized=True,
+                          interpret=True) == "pallas_q8"
+    assert resolve_method("scatter", quantized=True) == "onehot_q8"
+    assert resolve_method("onehot_hilo", quantized=True) == "onehot_q8"
+    # and without the flag, auto off-TPU keeps the scatter fast path
+    # unless interpret asks for the kernel pipeline
+    if on_cpu:
+        assert resolve_method("auto") == "scatter"
+        assert resolve_method("auto", interpret=True) == "pallas_hilo"
+        assert resolve_method("auto", deterministic=True,
+                              interpret=True) == "pallas"
+
+
+def test_quantized_grad_end_to_end():
+    """quantized_grad=true trains end to end (int8 stochastic-rounding
+    grad/hess, exact int32 histograms, f32 rescale at split time) with
+    accuracy close to full precision, and refuses the contradictory
+    f64-histogram combination."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(7)
+    n = 3000
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.6 * X[:, 1] + 0.2 * rng.normal(size=n) > 0).astype(
+        np.float64)
+
+    def acc(params):
+        ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+        booster = lgb.train({"objective": "binary", "num_leaves": 31,
+                             "verbosity": -1, **params},
+                            ds, num_boost_round=15)
+        return float(np.mean((booster.predict(X) > 0.5) == (y > 0.5)))
+
+    a_full = acc({})
+    a_q8 = acc({"quantized_grad": True})
+    assert a_q8 >= a_full - 0.01, (a_full, a_q8)
+
+    with pytest.raises(ValueError, match="quantized_grad and gpu_use_dp"):
+        ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+        lgb.train({"objective": "binary", "quantized_grad": True,
+                   "gpu_use_dp": True, "verbosity": -1}, ds,
+                  num_boost_round=1)
+
+
+def test_autotune_hook():
+    """autotune_hist: a no-op off-TPU (no timing, defaults returned);
+    force_measure runs the interpreter candidates, returns a candidate
+    block + the structural 128-lane leaf batch, and caches per shape
+    bucket."""
+    rng = np.random.RandomState(8)
+    binsT = jnp.asarray(rng.randint(0, 16, size=(3, 600)).astype(np.int8))
+    if jax.default_backend() != "tpu":
+        assert pallas_hist.autotune_hist(binsT, 16) == \
+            {"block": 0, "tile_leaves": 0}
+    tuned = pallas_hist.autotune_hist(binsT, 16, mode="hilo",
+                                      block_candidates=(512, 1024),
+                                      force_measure=True)
+    assert tuned["tile_leaves"] == 42                 # 128 // 3
+    assert tuned["block"] in (0, 512, 1024)
+    key = (3, 16, 600 .bit_length(), "hilo")
+    assert pallas_hist._tuned[key] == tuned
+    # cache hit: identical dict back without re-measuring
+    assert pallas_hist.autotune_hist(binsT, 16, mode="hilo",
+                                     force_measure=True) == tuned
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="real Mosaic compile needs a TPU backend")
+def test_tpu_compile_all_modes():
+    """TPU-only: both kernel forms COMPILE (Mosaic, not interpreter) for
+    every mode at a production-like small shape. Kept out of tier-1 by the
+    skip; ``-m pallas`` on a TPU host runs it."""
+    n, f, b = 4096, 8, 255
+    binsT, bins, stats, leaf, sel = _mk(n, f, b)
+    stats8 = jnp.asarray(np.random.RandomState(0).randint(
+        -127, 128, size=(n, 3)).astype(np.int8))
+    idx = jnp.arange(2048, dtype=jnp.int32)
+    for mode in ("hilo", "highest", "q8"):
+        st = stats8 if mode == "q8" else stats
+        h = pallas_hist.histogram_tiles_pallas_mode(
+            binsT, st, leaf, sel, b, block=1024, mode=mode)
+        h.block_until_ready()
+        hg = pallas_hist.histogram_tiles_pallas_mode(
+            binsT, st, leaf, sel, b, block=1024, mode=mode, idx=idx)
+        hg.block_until_ready()
