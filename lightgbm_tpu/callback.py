@@ -230,6 +230,21 @@ def checkpoint(directory: str, period: int = 1, keep: int = 2) -> Callable:
         if state["mgr"] is None:
             state["mgr"] = CheckpointManager(directory, keep=keep,
                                              config=model.config)
+        # with divergence detection armed, a checkpoint written BETWEEN
+        # votes could capture corruption born since the last vote — the
+        # restore would then reload it and burn the rank's restart budget
+        # on a checkpoint the gang never certified. Vote before capturing
+        # state, so every published checkpoint is voted-clean (skipped
+        # when engine.train already voted this very iteration; the guard
+        # and the config are rank-symmetric, so the exchange stays in
+        # lockstep).
+        integ = int(getattr(boosting.config, "integrity_check_period", 0)
+                    or 0)
+        if integ > 0 \
+                and getattr(boosting, "_integrity_checked_iter", None) \
+                != env.iteration:
+            from . import distributed
+            distributed.check_model_integrity(boosting, env.iteration)
         state["mgr"].save(model, env.iteration + 1)
     _callback.order = 40
     return _callback

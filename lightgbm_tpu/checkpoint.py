@@ -80,10 +80,19 @@ _NON_TRAINING_PARAMS = frozenset({
     "hist_autotune",
     "heartbeat_interval", "collective_deadline", "max_restarts",
     "rank_restart_budget", "min_world_size",
+    # training-integrity knobs: the divergence-check cadence and the OOM
+    # fallback GATE steer supervision, not the trained model (a degrade
+    # EVENT does change numerics — which is why the degraded configuration
+    # itself rides the trainer state, see GBDT.get_trainer_state
+    # "oom_degrade" — but toggling the gate between runs must not reject
+    # an otherwise-valid resume)
+    "integrity_check_period", "hist_oom_fallback",
     "fault_kill_at_iter", "fault_hang_at_iter", "fault_kill_in_ckpt_write",
     "fault_nan_grad_at_iter", "fault_corrupt_checkpoint",
     "fault_kill_rank_at_iter", "fault_hang_rank_at_iter",
     "fault_kill_in_shard_write", "fault_corrupt_shard",
+    "fault_flip_score_rank", "fault_nan_hist_at_iter",
+    "fault_oom_at_iter", "fault_oom_count",
 })
 
 

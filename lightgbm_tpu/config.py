@@ -219,9 +219,16 @@ class Config:
     verbosity: int = 1
     snapshot_freq: int = -1
     linear_tree: bool = False
-    # fail fast on NaN/Inf gradients/hessians/leaf outputs, naming the
-    # iteration and offending count before they poison the histograms
-    # (disables the fused/lazy fast paths while on — a debugging guard rail)
+    # fail fast on NaN/Inf gradients/hessians/leaf outputs/score deltas,
+    # naming the iteration and source before they poison the histograms.
+    # On the fused one-dispatch path the checks run IN-PROGRAM as numerics
+    # sentinels: a packed flag word (NaN/Inf bits per source) computed
+    # inside the compiled step and judged lazily via non-blocking ready
+    # checks (so the fetch never stalls the dispatch pipeline;
+    # state-capture paths flush it first, so poisoned state is never
+    # written) — the guard works WITH fused_iteration and quantized-grad
+    # training (it no longer gates them off; the unfused path keeps the
+    # host-side counting checks)
     check_numerics: bool = False
 
     # Checkpointing
@@ -260,6 +267,37 @@ class Config:
     # the smallest world size the supervisor may shrink a gang to; a loss
     # that would go below it exhausts the restart budget instead
     min_world_size: int = 1
+
+    # Training integrity (see README "Training integrity")
+    # every this many iterations, ranks exchange a cheap fingerprint of
+    # the global model state (tree-structure hash + a score-cache checksum
+    # over the rank's row range) over the coordination service and
+    # majority-vote any mismatch: a minority rank whose state silently
+    # diverged from the gang is named in a RankDivergenceError — or, under
+    # supervision, exits with DIVERGENCE_EXIT_CODE so the supervisor
+    # restarts it from the last valid checkpoint (and shrinks it away
+    # after rank_restart_budget). 0 disables; no-op single-process
+    integrity_check_period: int = 0
+    # catch RESOURCE_EXHAUSTED during histogram compile/execute and step
+    # down the documented degradation ladder (smaller histogram block ->
+    # hist_method -> XLA scatter -> chunked predict buckets) instead of
+    # killing the job; every degradation event lands in health_snapshot(),
+    # the gauges and the checkpoint manifest's health section so an
+    # operator can see the job is running degraded
+    hist_oom_fallback: bool = True
+    # flip ONE bit of rank r's train-score cache after 0-based iteration k
+    # ("r:k"; config twin of LGBM_TPU_FAULT_FLIP_SCORE_RANK) — the silent
+    # corruption the divergence check must attribute to exactly that rank
+    fault_flip_score_rank: str = ""
+    # poison one gradient value with NaN INSIDE the compiled program at
+    # this 0-based iteration (the fused path's sentinels must catch it;
+    # unlike fault_nan_grad_at_iter it does not unfuse the iteration)
+    fault_nan_hist_at_iter: int = -1
+    # raise a simulated RESOURCE_EXHAUSTED from the boosting step at this
+    # 0-based iteration, fault_oom_count consecutive times — drives the
+    # OOM degradation ladder one rung per raise
+    fault_oom_at_iter: int = -1
+    fault_oom_count: int = 1
 
     # Fault injection (testing)
     # hard-exit (like SIGKILL) at the start of this 0-based iteration;

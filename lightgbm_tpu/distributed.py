@@ -52,6 +52,14 @@ WATCHDOG_EXIT_CODE = 97
 # same-size restarts on a machine that cannot start
 SPAWN_FAIL_EXIT_CODE = 96
 
+# exit code a supervised rank uses when the cross-rank integrity check
+# (check_model_integrity) identifies IT as the minority whose model state
+# silently diverged from the gang: the supervisor charges the corrupt
+# rank's restart budget (like a hard kill — the rank's state is bad by
+# majority evidence) and restarts the gang from the last valid checkpoint,
+# or shrinks the rank away once the budget is exhausted
+DIVERGENCE_EXIT_CODE = 95
+
 
 def is_initialized() -> bool:
     return _initialized or _jax_already_initialized()
@@ -602,6 +610,18 @@ def notify_step_end(iteration: int) -> None:
     _progress.end(iteration)
 
 
+def notify_step_retry(iteration: int) -> None:
+    """Re-arm the step clock for a RETRIED iteration (the OOM degradation
+    ladder): the failed attempt's elapsed time must not be charged to the
+    retry, and the retry recompiles the degraded programs — so it gets the
+    same compile exemption as a first step (the watchdog skips
+    ``step-retry:`` phases; degradation is single-process only, so no peer
+    is left waiting on an exempted collective). Counters are untouched:
+    the iteration did not complete."""
+    _progress.end()
+    _progress.begin(f"step-retry:{iteration}", iteration)
+
+
 class watchdog_phase:
     """Context manager marking a non-step collective phase (barriers,
     allgathers) so the watchdog times it too. Reentrant; no-op overhead
@@ -862,6 +882,12 @@ class CollectiveWatchdog:
             # incarnation timeout.
             if snap["phase"].startswith("step:") and snap["steps_done"] < 1:
                 continue
+            # an OOM-degraded retry recompiles the shrunk programs: same
+            # rationale as the first-step exemption (and single-process by
+            # construction — gangs fail-stop on OOM, so no stalled peer
+            # hides behind this phase)
+            if snap["phase"].startswith("step-retry:"):
+                continue
             if snap["phase_elapsed"] > self.deadline:
                 self._fire(snap)
                 return
@@ -992,7 +1018,10 @@ def start_health(config=None, heartbeat_addr: Optional[str] = None) -> _Health:
 def health_snapshot() -> dict:
     """Health telemetry for bench.py JSON and checkpoint manifests:
     restart count (from the supervisor's env), this process's progress,
-    and the per-rank heartbeat table when a monitor is live."""
+    the per-rank heartbeat table when a monitor is live, and every OOM
+    degradation event this process stepped down (an operator reading a
+    manifest can see a job is running DEGRADED rather than discovering it
+    at the bill)."""
     snap = _progress.snapshot()
     out = {
         "restart_count": int(os.environ.get(_RESTART_COUNT_ENV, "0") or 0),
@@ -1008,7 +1037,239 @@ def health_snapshot() -> dict:
         out["heartbeat_interval"] = h.heartbeat.interval
     if h is not None and h.watchdog is not None:
         out["collective_deadline"] = h.watchdog.deadline
+    if _degradations:
+        out["degradations"] = list(_degradations)
     return out
+
+
+# ====================================================== training integrity
+# The verification half of the fail-silent story: the fail-stop machinery
+# above (heartbeats, watchdog, supervisor) catches ranks that DIE or HANG;
+# this layer catches ranks whose state silently diverged (bit flips, bad
+# DIMMs, kernel nondeterminism) and jobs that keep running but degraded
+# (OOM fallbacks). The reference's distributed learners stay correct only
+# because every rank executes bit-identical reductions — here that
+# invariant is CHECKED: every ``integrity_check_period`` iterations the
+# ranks exchange a cheap fingerprint of the global model state over the
+# coordination service and majority-vote any mismatch.
+
+# OOM degradation events this process recorded (models/gbdt.py
+# _maybe_degrade_oom): surfaced through health_snapshot() and therefore
+# every later checkpoint manifest's health section
+_degradations: List[dict] = []
+
+
+def record_degradation(event: dict) -> None:
+    """Record one degradation event (kind/iteration/level/action/error)."""
+    event = dict(event)
+    event["seq"] = len(_degradations)
+    _degradations.append(event)
+    from .utils import profiling
+    profiling.set_gauge("oom_degradations", float(len(_degradations)))
+
+
+def degradations() -> List[dict]:
+    """Degradation events recorded so far (in order)."""
+    return list(_degradations)
+
+
+def reset_degradations() -> None:
+    """Clear the process-level degradation log. Called when a NEW
+    training run initializes (GBDT._init_train) so a later booster's
+    health snapshots — and therefore its checkpoint manifests — don't
+    report an earlier, unrelated booster's events as their own."""
+    _degradations.clear()
+    from .utils import profiling
+    profiling.set_gauge("oom_degradations", 0.0)
+
+
+class RankDivergenceError(Exception):
+    """The cross-rank integrity check found ranks whose model state does
+    not match the gang's majority. ``corrupt_ranks`` names the minority
+    (the ranks whose state diverged); with ``indeterminate`` no majority
+    exists (e.g. a 1:1 split at world size 2) and the listed ranks are
+    merely the disagreeing parties — restart the whole gang from the last
+    checkpoint."""
+
+    def __init__(self, iteration: int, corrupt_ranks, table,
+                 indeterminate: bool = False):
+        self.iteration = int(iteration)
+        self.corrupt_ranks = list(corrupt_ranks)
+        self.table = table
+        self.indeterminate = bool(indeterminate)
+        if indeterminate:
+            msg = (f"model-state divergence detected at iteration "
+                   f"{iteration}: ranks {self.corrupt_ranks} disagree and "
+                   f"no majority exists — cannot name the corrupt rank; "
+                   f"restart the gang from the last valid checkpoint")
+        else:
+            msg = (f"model-state divergence detected at iteration "
+                   f"{iteration}: rank(s) {self.corrupt_ranks} hold state "
+                   f"that differs from the gang's majority (silent "
+                   f"corruption — bit flip, bad memory, or "
+                   f"nondeterministic kernel). Restart the corrupt "
+                   f"rank(s) from the last valid checkpoint "
+                   f"(lightgbm_tpu.supervisor does this automatically).")
+        super().__init__(msg)
+
+
+def model_fingerprint(boosting) -> dict:
+    """Cheap fingerprint of one rank's view of the global model state:
+
+    - ``trees``: sha256 over every tree's structure AND values (split
+      feature/threshold-bin per node, leaf values) — rank-symmetric by the
+      SPMD contract, so it is comparable across EVERY rank;
+    - ``score``: sha256 of the exact f32 train-score-cache bytes over this
+      rank's row range — comparable only between ranks holding the same
+      rows (all of them when replicated; recorded with the row range so
+      the vote groups pre-partitioned ranks correctly).
+
+    Reading it flushes the async host-tree mirrors and fetches the score
+    cache — a per-``integrity_check_period`` cost, not per-iteration."""
+    import hashlib
+    import numpy as np
+    h = hashlib.sha256()
+    for ht in boosting.host_trees:
+        nl = int(ht.num_leaves)
+        nn = max(nl - 1, 0)
+        h.update(np.int32(nl).tobytes())
+        h.update(np.ascontiguousarray(
+            np.asarray(ht.split_feature[:nn], np.int32)).tobytes())
+        h.update(np.ascontiguousarray(
+            np.asarray(ht.threshold_bin[:nn], np.int64)).tobytes())
+        h.update(np.ascontiguousarray(
+            np.asarray(ht.leaf_value[:nl], np.float64)).tobytes())
+    score = np.ascontiguousarray(
+        np.asarray(boosting.train_score, np.float32))
+    ts = boosting.train_set
+    row_start = int(getattr(ts, "local_row_start", 0) or 0) \
+        if ts is not None else 0
+    return {
+        "rank": jax_rank(),
+        "trees": h.hexdigest(),
+        "score": hashlib.sha256(score.tobytes()).hexdigest(),
+        "row_start": row_start,
+        "row_count": int(score.shape[0]),
+    }
+
+
+def jax_rank() -> int:
+    import jax
+    try:
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def divergence_verdict(entries):
+    """Majority vote over per-rank fingerprints. Returns
+    ``(corrupt_ranks, indeterminate)``: the minority rank(s) whose
+    fingerprints differ from a strict majority, or — when no strict
+    majority exists for some disputed component — every disagreeing rank
+    with ``indeterminate=True``. Tree hashes vote globally (they are
+    rank-symmetric); score checksums vote only within groups of ranks
+    holding the SAME row range (pre-partitioned ranks hold disjoint rows
+    whose checksums differ by design)."""
+    from collections import Counter
+    suspects = set()
+    indeterminate = False
+
+    def vote(group, key):
+        nonlocal indeterminate
+        counts = Counter(key(e) for e in group)
+        if len(counts) <= 1:
+            return
+        _, best_n = counts.most_common(1)[0]
+        if best_n * 2 <= len(group):
+            indeterminate = True
+            suspects.update(int(e["rank"]) for e in group)
+        else:
+            best = counts.most_common(1)[0][0]
+            suspects.update(int(e["rank"]) for e in group
+                            if key(e) != best)
+
+    vote(entries, lambda e: e["trees"])
+    by_range: Dict[tuple, list] = {}
+    for e in entries:
+        by_range.setdefault(
+            (int(e.get("row_start", 0)), int(e.get("row_count", -1))),
+            []).append(e)
+    for group in by_range.values():
+        if len(group) > 1:
+            vote(group, lambda e: e["score"])
+    return sorted(suspects), indeterminate
+
+
+def check_model_integrity(boosting, iteration: int,
+                          timeout: Optional[float] = None) -> None:
+    """Cross-rank divergence check, called in lockstep on every rank
+    every ``integrity_check_period`` iterations (engine.train). Exchanges
+    each rank's :func:`model_fingerprint` over the coordination service
+    (pure gRPC — works on backends without cross-process XLA) and
+    majority-votes mismatches.
+
+    Clean gang: returns. Divergence, unsupervised: raises
+    :class:`RankDivergenceError` on every rank, naming the minority.
+    Divergence, supervised (LGBM_TPU_SUPERVISED=1): the CORRUPT rank
+    writes a ``divergence_rank{r}.json`` diagnosis and exits with
+    ``DIVERGENCE_EXIT_CODE`` so the supervisor restarts the gang from the
+    last valid checkpoint charging that rank's restart budget (a rank
+    that keeps diverging is shrunk away); honest ranks log and continue —
+    the supervisor tears them down and relaunches. No-op single-process."""
+    import jax
+    if jax.process_count() <= 1:
+        return
+    from .utils import profiling
+    mine = model_fingerprint(boosting)
+    payloads = exchange_host(f"integrity_{iteration}", json.dumps(mine),
+                             timeout=timeout)
+    entries = [json.loads(p) for p in payloads]
+    corrupt, indeterminate = divergence_verdict(entries)
+    profiling.set_gauge("integrity_checks_run",
+                        profiling.gauges().get("integrity_checks_run", 0.0)
+                        + 1.0)
+    profiling.set_gauge("integrity_last_iteration", float(iteration))
+    # dedup marker: the checkpoint callback votes before every save but
+    # must not re-vote an iteration engine.train already certified
+    boosting._integrity_checked_iter = int(iteration)
+    if not corrupt:
+        return
+    table = {str(e["rank"]): {"trees": e["trees"][:16],
+                              "score": e["score"][:16]} for e in entries}
+    err = RankDivergenceError(iteration, corrupt, table,
+                              indeterminate=indeterminate)
+    rank = mine["rank"]
+    supervised = os.environ.get(_SUPERVISED_ENV) == "1"
+    if supervised and not indeterminate:
+        if rank in corrupt:
+            # write the diagnosis the supervisor folds into its report,
+            # then exit with the divergence code: by majority evidence
+            # THIS rank's state is bad, and a checkpoint restore is the
+            # only way back to the gang's truth
+            diag_dir = os.environ.get(_DIAG_DIR_ENV)
+            diag = {"rank": rank, "iteration": int(iteration),
+                    "corrupt_ranks": corrupt, "fingerprints": table,
+                    "kind": "divergence"}
+            if diag_dir:
+                try:
+                    os.makedirs(diag_dir, exist_ok=True)
+                    with open(os.path.join(
+                            diag_dir, f"divergence_rank{rank}.json"),
+                            "w") as fh:
+                        json.dump(diag, fh, indent=1)
+                except OSError:
+                    pass
+            import sys
+            sys.stderr.write(f"[integrity] {err}\n")
+            sys.stderr.flush()
+            os._exit(DIVERGENCE_EXIT_CODE)
+        # honest majority rank: its state is good — log and keep going;
+        # the supervisor reaps the corrupt rank's exit, tears this gang
+        # down and relaunches it from the last valid checkpoint
+        log.warning(f"integrity check: {err} (this rank is in the "
+                    f"majority; awaiting supervisor restart)")
+        return
+    raise err
 
 
 def shutdown() -> None:

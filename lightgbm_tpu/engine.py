@@ -178,6 +178,15 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     # diagnosable DistributedTimeoutError (or a supervised gang restart),
     # never an indefinite collective stall
     health = distributed.start_health(booster.config)
+    # cross-rank divergence detection (the training-integrity layer): every
+    # integrity_check_period iterations the ranks exchange a model-state
+    # fingerprint and majority-vote mismatches — run BEFORE the after-
+    # iteration callbacks so a checkpoint is never written from state the
+    # gang has already voted corrupt. No-op single-process / when 0.
+    import jax
+    integ_period = int(getattr(booster.config, "integrity_check_period", 0)
+                       or 0)
+    integ_on = integ_period > 0 and jax.process_count() > 1
     try:
         for i in range(start_iter, num_boost_round):
             faults.maybe_kill(fault_plan, i)
@@ -187,6 +196,8 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                                begin_iteration=0, end_iteration=num_boost_round,
                                evaluation_result_list=None))
             booster.update(fobj=fobj)
+            if integ_on and (i + 1) % integ_period == 0:
+                distributed.check_model_integrity(booster._boosting, i)
 
             evaluation_result_list = []
             if valid_sets or booster._boosting.config.is_provide_training_metric:
@@ -201,6 +212,10 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                 for item in es.best_score:
                     booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
                 break
+        # judge every still-deferred numerics sentinel (the fused path's
+        # flag words are fetched lazily; without this flush a NaN born in
+        # the final rounds could go unreported)
+        booster._boosting._flush_sentinel()
     finally:
         health.stop()
     return booster

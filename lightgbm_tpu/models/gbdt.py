@@ -39,6 +39,18 @@ from .tree import (HostTree, TreeArrays, predict_leaf_bins,
 import functools
 
 
+# bit -> source name of the fused step's in-program sentinel flag word
+# (see _fused_step_fn: packed NaN/Inf bits computed inside the compiled
+# program and fetched with the iteration's own results)
+_SENTINEL_SOURCES = (
+    (0, "gradients"),
+    (1, "hessians"),
+    (2, "histogram sums (in-program, Pallas/XLA histogram path)"),
+    (3, "leaf outputs"),
+    (4, "score delta"),
+)
+
+
 def _chunk_iters_cap(n: int, k: int, itemsize: int) -> int:
     """Iterations per stacked-predict dispatch so the [t, n, k] host buffer
     stays under ~256 MB."""
@@ -209,6 +221,21 @@ class GBDT:
         self._valid_scores: List[jax.Array] = []
         self.metric_names: List[str] = []
         self.best_score: Dict[str, Dict[str, float]] = {}
+        # OOM degradation ladder state (see _maybe_degrade_oom): how many
+        # rungs this booster has stepped down, and the resulting overrides.
+        # Rides the trainer state so a resumed incarnation keeps the
+        # degraded (numerics-relevant) configuration — the bit-identical-
+        # restart contract, same as the measured histogram method.
+        self._oom_level = 0
+        self._oom_block = 0            # rung 1: forced smaller hist block
+        self._oom_hm: Optional[str] = None   # rung 2: forced XLA fallback
+        self._oom_predict_chunk = 0    # rung 3: forced predict chunk rows
+        # deferred in-program sentinel words from the fused path: FIFO of
+        # (iteration, device flag scalar), judged as their steps complete
+        # (_drain_sentinels, non-blocking) so the fetch never stalls the
+        # dispatch pipeline; flushed blockingly at every state-capture
+        # point (_flush_sentinel)
+        self._sentinel_pending: List[tuple] = []
         if train_set is not None:
             self._init_train(train_set)
 
@@ -224,6 +251,7 @@ class GBDT:
         dispatch pipeline; deferring it lets XLA queue iterations
         back-to-back (the same reason the reference keeps its tree on the
         training thread and only serializes at save time)."""
+        self._flush_sentinel()
         self._flush_pending()
         return self._host_trees
 
@@ -257,18 +285,23 @@ class GBDT:
                 if self._splitless_in_group >= self.num_tree_per_iteration:
                     self._lagged_stop = True
 
-    def _lazy_host_ok(self) -> bool:
+    def _lazy_host_ok(self, sentinels: bool = False) -> bool:
         """Whether this iteration can defer the host tree fetch: nothing in
         the iteration itself needs host-side tree data. First iteration
         stays synchronous (boost-from-average bias fold + the TIMETAG
         first-iter sample); leaf-renewal objectives rewrite leaf values on
-        host before the score update; linear trees fit on host."""
+        host before the score update; linear trees fit on host.
+        ``sentinels``: the fused path's in-program numerics sentinels
+        already cover the leaf outputs, so check_numerics no longer forces
+        the synchronous host-mirror fetch there (the unfused path keeps
+        it: its leaf check reads the host mirror in _finalize_tree)."""
         return (self._supports_lazy_host
                 and self.iter >= 1
                 and not self.config.linear_tree
                 # check_numerics inspects each tree's leaf outputs in
-                # _finalize_tree, which the lazy path skips
-                and not self.config.check_numerics
+                # _finalize_tree, which the lazy path skips — unless the
+                # in-program sentinels are doing that job
+                and not (self.config.check_numerics and not sentinels)
                 and not (self.objective is not None
                          and self.objective.need_renew_tree_output))
 
@@ -282,10 +315,15 @@ class GBDT:
 
     # ------------------------------------------------------------ setup
     def _init_train(self, train_set: Dataset) -> None:
+        from .. import distributed
         from ..utils import faults
         train_set.construct()
         cfg = self.config
         self._fault_plan = faults.plan_from(cfg)
+        # a fresh training run starts with a clean process-level
+        # degradation log: this booster's health snapshots / checkpoint
+        # manifests must not inherit an earlier booster's OOM events
+        distributed.reset_degradations()
         # pre-partitioned mode (distributed.load_partitioned): bins are a
         # global row-sharded array; labels/weights/scores/gradients stay
         # PROCESS-LOCAL (the reference's per-machine score partition,
@@ -738,16 +776,22 @@ class GBDT:
         What remains excluded genuinely interleaves HOST work between the
         phases: externally supplied gradients (fobj), objectives with
         host-side leaf renewal, linear-leaf fitting (host lstsq per leaf),
-        the check_numerics / NaN-injection guards (they inspect gradients
-        on host by design), and multi-controller / pre-partitioned runs
-        (per-process array globalization between phases)."""
+        the NaN-GRADIENT injection fault (it materializes gradients on
+        host by design; the in-program nan_hist fault does not unfuse),
+        and multi-controller / pre-partitioned runs (per-process array
+        globalization between phases). ``check_numerics`` is NOT excluded
+        anymore: the fused step computes an in-program sentinel flag word
+        (packed NaN/Inf bits for gradients, hessians, the histogram
+        plane, leaf outputs and the score delta) that the host checks
+        from the iteration's own results — the guard works WITH the fused
+        path instead of gating it off (PR 3's limitation, lifted)."""
         cfg = self.config
         return (type(self) is GBDT
                 and cfg.fused_iteration
                 and grad_external is None
-                # numerics checks and NaN-gradient injection both need the
-                # gradients materialized outside the fused program
-                and not cfg.check_numerics
+                # NaN-gradient injection needs the gradients materialized
+                # outside the fused program (check_numerics does not: see
+                # the sentinel note above)
                 and (self._fault_plan is None
                      or not self._fault_plan.wants_nan_grad)
                 and self.objective is not None
@@ -773,8 +817,9 @@ class GBDT:
         return dict(
             max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
             max_depth=cfg.max_depth, hist_method=hm,
-            tile_leaves=tile, hist_block=blk,
+            tile_leaves=tile, hist_block=self._eff_hist_block(blk),
             hist_interpret=self._hist_interpret(),
+            numerics_sentinels=cfg.check_numerics,
             feature_block=fb,
             exact=cfg.tree_growth_mode == "exact",
             with_categorical=ts.has_categorical,
@@ -801,8 +846,9 @@ class GBDT:
             max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
             max_depth=cfg.max_depth, hist_method=hm,
             tile_leaves=cfg.tile_leaves,
-            hist_block=cfg.hist_block,
+            hist_block=self._eff_hist_block(cfg.hist_block),
             hist_interpret=self._hist_interpret(),
+            numerics_sentinels=cfg.check_numerics,
             exact=cfg.tree_growth_mode == "exact",
             with_categorical=ts.has_categorical,
             with_monotone=self._with_monotone,
@@ -850,7 +896,8 @@ class GBDT:
                 used_split=jnp.zeros((f,), bool),
                 row_used=jnp.zeros((n, f) if lazy else (1, 1), bool),
                 rows_streamed=jnp.float32(0.0),
-                coll_bytes=jnp.float32(0.0))
+                coll_bytes=jnp.float32(0.0),
+                sentinel=jnp.float32(0.0))
         return self._cegb_aux
 
     def _fused_parallel_bindings(self, hm: str):
@@ -932,8 +979,15 @@ class GBDT:
             else bag_mode
         grow_kw = self._parallel_grow_statics(hm) if pg is not None \
             else self._serial_grow_statics(hm)
+        # in-program numerics sentinels (check_numerics on the fused path)
+        # and the traced NaN-injection fault are STATICS of the program:
+        # the disarmed trace is byte-identical to a guard-free one
+        from ..utils import faults as faults_mod
+        sentinels = bool(cfg.check_numerics)
+        nan_hist_it = faults_mod.nan_hist_iter(self._fault_plan)
         key = (id(obj), k, bag_mode, sub_k, frac_kind, fmask_on,
                pg.mode if pg is not None else "serial",
+               sentinels, nan_hist_it,
                cfg.bagging_freq, cfg.bagging_seed, cfg.extra_seed,
                # the by-node fraction is closed over below (a constant of
                # the program): key it so a reset_parameter change
@@ -978,6 +1032,13 @@ class GBDT:
                  cegb_state, sp_rows, sp_bins, sp_default, extras,
                  rows_acc, coll_acc):
             g, h = obj.get_grad_hess(score)
+            if nan_hist_it >= 0:
+                # traced NaN injection (LGBM_TPU_FAULT_NAN_HIST_AT_ITER):
+                # poison one gradient value INSIDE the program at the
+                # armed iteration — the failure shape the in-program
+                # sentinels exist for (a host-side injection would unfuse)
+                gf = g.reshape(-1).at[0].set(jnp.nan).reshape(g.shape)
+                g = jnp.where(jnp.equal(it, nan_hist_it), gf, g)
             # ---- bagging, derived from the period-start key: the exact
             # draw _update_bagging performs on the host path
             mask = jnp.ones((n,), jnp.float32)
@@ -1028,6 +1089,7 @@ class GBDT:
                 tree, delta, aux = grow_c(g, h, fm[0], key0, cegb_state)
                 trees = (tree,)
                 rows, coll = aux.rows_streamed, aux.coll_bytes
+                hist_sent = aux.sentinel
                 cegb_out = aux if cegb_on else None
             else:
                 keys = jax.vmap(
@@ -1041,17 +1103,34 @@ class GBDT:
                                                 cegb_state)
                     return (aux if cegb_on else carry,
                             (tree, delta_c, aux.rows_streamed,
-                             aux.coll_bytes))
+                             aux.coll_bytes, aux.sentinel))
 
                 carry0 = cegb_state if cegb_on else jnp.int32(0)
-                carry, (trees_st, delta, rows_st, coll_st) = jax.lax.scan(
-                    body, carry0, (g.T, h.T, fm, keys))
+                carry, (trees_st, delta, rows_st, coll_st, sent_st) = \
+                    jax.lax.scan(body, carry0, (g.T, h.T, fm, keys))
                 trees = tuple(jax.tree.map(lambda x: x[c], trees_st)
                               for c in range(k))
                 rows, coll = jnp.sum(rows_st), jnp.sum(coll_st)
+                hist_sent = jnp.sum(sent_st)
                 cegb_out = carry if cegb_on else None
+            if sentinels:
+                # the per-iteration sentinel flag word: packed NaN/Inf
+                # bits per SOURCE (see _SENTINEL_SOURCES), computed as
+                # tiny reductions fused into the step's epilogue and
+                # fetched by the host with this iteration's results — no
+                # extra dispatch, no host round trip of the arrays
+                bad = lambda x: jnp.any(~jnp.isfinite(x))  # noqa: E731
+                leaf_bad = functools.reduce(
+                    jnp.logical_or, [bad(t.leaf_value) for t in trees])
+                u32 = lambda b: b.astype(jnp.uint32)       # noqa: E731
+                flags = (u32(bad(g)) | (u32(bad(h)) << 1)
+                         | (u32(hist_sent > 0) << 2)
+                         | (u32(leaf_bad) << 3)
+                         | (u32(bad(delta)) << 4))
+            else:
+                flags = jnp.uint32(0)
             return (trees, delta, rows_acc + rows, coll_acc + coll,
-                    cegb_out)
+                    cegb_out, flags)
 
         step = jax.jit(step)
         if len(self._fused_cache) >= 8:
@@ -1071,23 +1150,56 @@ class GBDT:
         dead or hung peer stalls this step's collectives forever, so the
         collective_deadline watchdog (distributed.CollectiveWatchdog) times
         the fused/unfused step and converts an over-deadline stall into a
-        diagnosable DistributedTimeoutError / supervised gang restart."""
+        diagnosable DistributedTimeoutError / supervised gang restart.
+
+        It also hosts the OOM degradation ladder: a RESOURCE_EXHAUSTED
+        from the histogram programs (compile or execute) steps the booster
+        down one documented rung (_maybe_degrade_oom) and RETRIES the
+        iteration instead of killing the job — the retry is safe because a
+        failed step mutates no trainer state (checked: the tree count must
+        be unchanged)."""
         from .. import distributed
+        from ..utils import faults
         it = self.iter
         distributed.notify_step_begin(it)
         try:
-            return self._train_one_iter_watched(grad, hess)
+            while True:
+                ntrees_before = len(self.trees)
+                try:
+                    stop = self._train_one_iter_watched(grad, hess)
+                    break
+                except Exception as e:
+                    if not self._maybe_degrade_oom(e, ntrees_before):
+                        raise
+                    # the retry recompiles the degraded programs under a
+                    # fresh clock — without this the failed attempt +
+                    # recompile could trip the collective-deadline
+                    # watchdog on the very iteration the ladder rescues
+                    distributed.notify_step_retry(it)
         finally:
             # on success self.iter advanced past ``it``: record completion;
             # on an exception the step did NOT complete and last_iter stays
             distributed.notify_step_end(it if self.iter > it else it - 1)
+        if self._fault_plan is not None:
+            # silent-corruption injection (FLIP_SCORE_RANK): one score-
+            # cache bit flipped AFTER the iteration completes, on one rank
+            # — the divergence check must attribute it to exactly that rank
+            flipped = faults.maybe_flip_score(self._fault_plan, it,
+                                              self.train_score)
+            if flipped is not None:
+                self.train_score = flipped
+        return stop
 
     def _train_one_iter_watched(self, grad: Optional[np.ndarray] = None,
                                 hess: Optional[np.ndarray] = None) -> bool:
+        from ..utils import faults as faults_mod
         from ..utils import profiling
         cfg = self.config
         ts = self.train_set
         k = self.num_tree_per_iteration
+        # simulated-OOM injection point for the degradation ladder (raises
+        # before any state mutates, so the retry in train_one_iter is safe)
+        faults_mod.maybe_oom(self._fault_plan, self.iter)
         if self._fused_ok(grad):
             # the fused program draws its own bagging mask/subset from the
             # period-start key — no host refresh dispatch
@@ -1103,6 +1215,8 @@ class GBDT:
         if self._fault_plan is not None:
             from ..utils import faults
             g, h = faults.maybe_nan_grad(self._fault_plan, self.iter, g, h)
+            # host-path twin of the in-program NaN injection
+            g, h = faults.maybe_nan_hist(self._fault_plan, self.iter, g, h)
         if cfg.check_numerics:
             self._check_numerics_grad(g, h)
         sample_weights = self._sample_weights(g, h)
@@ -1128,6 +1242,10 @@ class GBDT:
                 grow_scope.sync(tree.num_leaves)
             if aux is not None:
                 self._record_aux_counters(aux)
+                if cfg.check_numerics and float(aux.sentinel):
+                    # same judge as the fused path so the histogram-plane
+                    # defect is reported with ONE message either way
+                    self._check_sentinel_flags(1 << 2)
             # pre-partitioned: leaf_id comes back row-sharded; keep only
             # this process's rows for the local score update (the
             # reference's per-machine score partition, score_updater.hpp —
@@ -1192,7 +1310,7 @@ class GBDT:
                     float(self._coll_bytes_dev))
         with profiling.timer_sync("grow_tree") as grow_scope:
             (trees, delta, self._rows_streamed_dev,
-             self._coll_bytes_dev, cegb_aux) = step(
+             self._coll_bytes_dev, cegb_aux, sent_flags) = step(
                 self.train_score, bind["bins"], bind["binsT"], fmask,
                 self.split_params, np.int32(self.iter),
                 np.float32(self.shrinkage_rate), bag_frac, cegb_state,
@@ -1200,6 +1318,19 @@ class GBDT:
                 bind["extras"], self._rows_streamed_dev,
                 self._coll_bytes_dev)
             grow_scope.sync(trees[0].num_leaves)
+        if self.config.check_numerics:
+            # the flag word is judged LAZILY (_drain_sentinels below): a
+            # blocking scalar fetch here — or even a fixed one-iteration
+            # lag — serializes the host against the dispatch queue, the
+            # pipelining the fused path exists for (measured ~15-40% at
+            # small CPU shapes). Instead the device scalar joins a FIFO
+            # judged by non-blocking ready checks, the same lagged
+            # pattern as the async host-tree mirrors; every state-capture
+            # path (host_trees, get_trainer_state, training end) flushes
+            # it blockingly first, so poisoned state can briefly exist in
+            # memory but is never read out or written. Still 2
+            # dispatches/iter.
+            self._sentinel_pending.append((self.iter, sent_flags))
         if cegb_aux is not None:
             self._cegb_aux = cegb_aux
         if prev is not None:
@@ -1208,16 +1339,17 @@ class GBDT:
             profiling.counter("hist_coll_bytes",
                               float(self._coll_bytes_dev) - prev[1])
         self.train_score = _apply_score_delta(self.train_score, delta)
-        lazy = self._lazy_host_ok()
+        lazy = self._lazy_host_ok(sentinels=True)
         no_split = True
         for c, tree in enumerate(trees):
             with profiling.timer("finalize_tree"):
                 if lazy:
                     t_host, had_split = None, True
                 else:
-                    # trees arrive pre-shrunk; renew/linear/check_numerics
-                    # are all excluded by _fused_ok, so finalize reduces
-                    # to the host-mirror fetch
+                    # trees arrive pre-shrunk; renew/linear are excluded
+                    # by _fused_ok and check_numerics is covered by the
+                    # in-program sentinels, so finalize reduces to the
+                    # host-mirror fetch
                     t_host = jax.device_get(tree)
                     had_split = int(t_host.num_leaves) > 1
             no_split = no_split and not had_split
@@ -1227,6 +1359,7 @@ class GBDT:
                 self._bias_after_score(c, had_split)
         self.iter += 1
         self._flush_pending(only_ready=True)
+        self._drain_sentinels()
         return (not lazy and no_split) or self._lagged_stop
 
     def _grow_one(self, gc: jax.Array, hc: jax.Array, mask: jax.Array,
@@ -1418,6 +1551,12 @@ class GBDT:
     def _hist_method(self) -> str:
         from ..ops.histogram import measured_auto_method, resolve_method
         cfg = self.config
+        if self._oom_hm:
+            # rung 2 of the OOM degradation ladder: the forced XLA
+            # fallback overrides auto/measured selection until the
+            # booster (or a resumed incarnation: the override rides the
+            # trainer state) is rebuilt
+            return self._oom_hm
         if cfg.quantized_grad:
             # the quantized-gradient training mode overrides the measured
             # auto-selection: q8 changes numerics, so it is chosen by the
@@ -1478,6 +1617,169 @@ class GBDT:
                 f"{max(num_leaves, 1)} leaf outputs in the new tree are "
                 f"non-finite — failing fast before the score caches are "
                 f"poisoned")
+
+    def _check_sentinel_flags(self, flags: int,
+                              iteration: Optional[int] = None) -> None:
+        """Judge the fused step's in-program sentinel flag word: nonzero
+        bits name which sources carried NaN/Inf (see _SENTINEL_SOURCES) —
+        fail fast with the iteration and sources spelled out."""
+        if not flags:
+            return
+        it = self.iter if iteration is None else iteration
+        sources = [name for bit, name in _SENTINEL_SOURCES
+                   if flags & (1 << bit)]
+        log.fatal(
+            f"check_numerics: iteration {it}: in-program sentinels "
+            f"flagged non-finite values in {', '.join(sources)} "
+            f"(flag word 0b{flags:05b}) — failing fast before they poison "
+            f"the model on disk (check the objective / custom fobj, "
+            f"learning_rate, and input features)")
+
+    def _drain_sentinels(self) -> None:
+        """Judge every pending sentinel word whose step has already
+        finished — non-blocking ready checks, oldest first (so the FIRST
+        poisoned iteration is the one named), mirroring
+        ``_flush_pending(only_ready=True)``. A backend without
+        ``is_ready()`` judges everything (blocking) — the guard stays
+        correct, just without the pipelined fetch. The FIFO is bounded:
+        past 64 pending words the oldest is judged blockingly, which
+        bounds both memory and detection lag."""
+        q = self._sentinel_pending
+        while q:
+            it, flags = q[0]
+            if len(q) <= 64:
+                try:
+                    if not flags.is_ready():
+                        break
+                except AttributeError:
+                    pass
+            q.pop(0)
+            self._check_sentinel_flags(int(flags), it)
+
+    def _flush_sentinel(self) -> None:
+        """Blocking judge of EVERY deferred in-program sentinel word
+        (fused path). The per-iteration fetch is lazy (_drain_sentinels)
+        so it never stalls the dispatch pipeline; every state-capture
+        path — ``host_trees``, ``get_trainer_state`` (the checkpoint
+        capture), rollback, training end — flushes here first, so
+        poisoned state is never read out or written."""
+        q = self._sentinel_pending
+        while q:
+            it, flags = q.pop(0)
+            self._check_sentinel_flags(int(flags), it)
+
+    # ------------------------------------------------ OOM degradation
+    def _eff_hist_block(self, blk: int) -> int:
+        """Histogram row-block size after the OOM ladder's rung-1 override
+        (0 keeps the per-method auto default)."""
+        if not self._oom_block:
+            return blk
+        return self._oom_block if not blk else min(blk, self._oom_block)
+
+    def _maybe_degrade_oom(self, exc: BaseException,
+                           ntrees_before: int) -> bool:
+        """Step the booster down ONE rung of the documented OOM degradation
+        ladder and report whether the failed iteration may be retried:
+
+          1. smaller histogram row block (less transient VMEM/HBM per
+             pass, more passes),
+          2. ``hist_method`` -> the XLA scatter formulation (no one-hot
+             materialization, no Pallas VMEM tiles — the smallest-footprint
+             backend; q8 keeps its integer form via onehot_q8),
+          3. chunked predict buckets (bounds the eval/serving programs'
+             resident rows).
+
+        Every degradation is recorded in ``distributed.health_snapshot()``
+        (and therefore every later checkpoint manifest's health section),
+        the ``hist_oom_degrade_level`` gauge and a WARNING — the job keeps
+        running, but visibly DEGRADED, instead of dying. The degraded
+        configuration rides the trainer state (get_trainer_state) so a
+        resumed incarnation reuses it — same bit-identical-restart
+        contract as the measured histogram method. False (re-raise) when
+        the guard is off, the error is not a RESOURCE_EXHAUSTED, an
+        earlier class of this multiclass iteration already adopted a tree
+        (retry would double-count), or the ladder is exhausted."""
+        from .. import distributed
+        from ..utils import faults, profiling
+        if not self.config.hist_oom_fallback \
+                or not faults.is_resource_exhausted(exc):
+            return False
+        if jax.process_count() > 1:
+            # gangs FAIL-STOP on a training OOM instead of degrading: the
+            # ladder's rungs change accumulation shape (numerics), so one
+            # rank degrading alone would break the rank-symmetric
+            # reduction contract — and be named corrupt by the very
+            # divergence vote this layer adds. The supervisor's
+            # restart/shrink path owns rank-local resource failures.
+            log.warning(
+                f"RESOURCE_EXHAUSTED in boosting iteration {self.iter}: "
+                f"per-rank degradation is disabled in multi-process gangs "
+                f"(it would silently break the rank-symmetric reductions) "
+                f"— failing stop for the supervisor to restart or shrink")
+            return False
+        if len(self.trees) != ntrees_before:
+            return False
+        if self._oom_level >= 3:
+            return False
+        self._oom_level += 1
+        if self._oom_level == 1:
+            from ..ops.pallas_hist import oom_shrink_block
+            hm = self._hist_method()
+            _, blk = self._hist_tuning(hm)
+            self._oom_block = oom_shrink_block(blk)
+            action = f"hist_block -> {self._oom_block}"
+        elif self._oom_level == 2:
+            from ..ops.histogram import oom_fallback_method
+            self._oom_hm = oom_fallback_method(self._hist_method())
+            action = f"hist_method -> {self._oom_hm} (XLA fallback)"
+        else:
+            base = self.config.predict_chunk_rows or (1 << 22)
+            self._oom_predict_chunk = max(1 << 14, base // 4)
+            action = f"predict_chunk_rows -> {self._oom_predict_chunk}"
+        # degraded statics must recompile: drop every cached program that
+        # baked the old histogram configuration in
+        self._fused_cache.clear()
+        self._engine_cache.clear()
+        distributed.record_degradation({
+            "kind": "oom", "iteration": int(self.iter),
+            "level": int(self._oom_level), "action": action,
+            "error": str(exc)[:200]})
+        profiling.set_gauge("hist_oom_degrade_level", self._oom_level)
+        log.warning(
+            f"RESOURCE_EXHAUSTED in boosting iteration {self.iter}: "
+            f"degrading ({action}; ladder rung {self._oom_level}/3) and "
+            f"retrying — the job continues DEGRADED (recorded in "
+            f"health_snapshot()/gauges and checkpoint manifests)")
+        return True
+
+    def _maybe_degrade_predict_oom(self, exc: BaseException) -> bool:
+        """Predict-path entry to the ladder's rung 3: halve the effective
+        predict chunk (repeatably, floor 16k rows) so the serving program
+        holds fewer resident rows, and retry. Deliberately does NOT touch
+        ``_oom_level``: predict chunking is numerics-exact and independent
+        of the training rungs — a serve-time OOM must not consume the
+        hist-block/scatter rungs a later training OOM may still need."""
+        from .. import distributed
+        from ..utils import faults, profiling
+        if not self.config.hist_oom_fallback \
+                or not faults.is_resource_exhausted(exc):
+            return False
+        cur = self._oom_predict_chunk \
+            or self.config.predict_chunk_rows or (1 << 22)
+        if cur <= (1 << 14):
+            return False
+        self._oom_predict_chunk = max(1 << 14, cur // 2)
+        self._engine_cache.clear()
+        action = f"predict_chunk_rows -> {self._oom_predict_chunk}"
+        distributed.record_degradation({
+            "kind": "oom_predict", "iteration": int(self.iter),
+            "level": int(self._oom_level), "action": action,
+            "error": str(exc)[:200]})
+        profiling.set_gauge("predict_oom_chunk_rows",
+                            float(self._oom_predict_chunk))
+        log.warning(f"RESOURCE_EXHAUSTED in predict: degrading ({action}) "
+                    f"and retrying")
+        return True
 
     def _record_aux_counters(self, aux: GrowAux) -> None:
         """Accumulate a tree's histogram-pass row count and collective
@@ -1811,6 +2113,7 @@ class GBDT:
         """reference: gbdt.cpp:454-470 RollbackOneIter."""
         if self.iter <= 0:
             return
+        self._flush_sentinel()
         self._flush_pending()
         # the popped iteration must not leave a stale stop signal behind
         self._lagged_stop = False
@@ -1863,6 +2166,7 @@ class GBDT:
         (bagging, GOSS, extra_trees) are fold_in(seed, iter) and need no
         state; the numpy RNGs (feature fraction; DART's drop RNG in the
         subclass) are stateful and serialize their full state."""
+        self._flush_sentinel()
         self._flush_pending()
         state = {
             "name": self.name,
@@ -1888,6 +2192,16 @@ class GBDT:
             # bit-identical-restart contract
             "measured_hm": getattr(self, "_measured_hm", None),
             "hist_tuned": getattr(self, "_hist_tuned", None),
+            # the OOM degradation ladder's position: a resumed incarnation
+            # must train with the SAME degraded configuration (block size /
+            # histogram method change the accumulation shape — numerics)
+            # or the bit-identical-restart contract breaks
+            "oom_degrade": ({"level": self._oom_level,
+                             "block": self._oom_block,
+                             "hm": self._oom_hm,
+                             "predict_chunk": self._oom_predict_chunk}
+                            if (self._oom_level
+                                or self._oom_predict_chunk) else None),
             "cegb_aux": (jax.device_get(self._cegb_aux)
                          if self._cegb_aux is not None else None),
             "loaded_iters": self.loaded_iters,
@@ -1930,8 +2244,20 @@ class GBDT:
             self._measured_hm = state["measured_hm"]
         if state.get("hist_tuned") is not None:
             self._hist_tuned = state["hist_tuned"]
+        od = state.get("oom_degrade")
+        if od:
+            self._oom_level = int(od.get("level", 0))
+            self._oom_block = int(od.get("block", 0))
+            self._oom_hm = od.get("hm")
+            self._oom_predict_chunk = int(od.get("predict_chunk", 0))
         if state.get("cegb_aux") is not None:
             self._cegb_aux = jax.tree.map(jnp.asarray, state["cegb_aux"])
+            if getattr(self._cegb_aux, "sentinel", None) is None:
+                # pre-sentinel checkpoint: the pickled aux has no sentinel
+                # array; materialize the disarmed zero so the fused step's
+                # operand structure stays trace-stable
+                self._cegb_aux = self._cegb_aux._replace(
+                    sentinel=jnp.float32(0.0))
         if state.get("loaded_model_text"):
             from ..io.model_text import load_model
             self.loaded = load_model(state["loaded_model_text"], self.config)
@@ -2206,12 +2532,17 @@ class GBDT:
             b = np.asarray(self.tree_bias[:nt], np.float64)
             if b.size and np.any(b):
                 biases = b
+        chunk = cfg.predict_chunk_rows
+        if self._oom_predict_chunk:
+            # OOM ladder rung 3: bound the serving program's resident rows
+            chunk = self._oom_predict_chunk if not chunk \
+                else min(chunk, self._oom_predict_chunk)
         eng = PredictEngine(
             stacked, self.num_tree_per_iteration, nt,
             self._ensemble_depth(nt), biases=biases,
             accum=cfg.predict_accum,
             bucket_min_rows=cfg.predict_bucket_min_rows,
-            chunk_rows=cfg.predict_chunk_rows,
+            chunk_rows=chunk,
             sharded=cfg.predict_sharded)
         if len(self._engine_cache) >= 2:
             self._engine_cache.pop(next(iter(self._engine_cache)))
@@ -2325,6 +2656,26 @@ class GBDT:
                     pred_early_stop_freq: int = 10,
                     pred_early_stop_margin: float = 10.0,
                     _postprocess=None) -> np.ndarray:
+        """``_predict_raw_impl`` under the OOM degradation ladder's
+        predict rung: a RESOURCE_EXHAUSTED from the engine programs
+        shrinks the chunk size (recorded in health_snapshot()) and
+        retries instead of failing the serve call."""
+        while True:
+            try:
+                return self._predict_raw_impl(
+                    X, num_iteration, start_iteration, pred_early_stop,
+                    pred_early_stop_freq, pred_early_stop_margin,
+                    _postprocess)
+            except Exception as e:
+                if not self._maybe_degrade_predict_oom(e):
+                    raise
+
+    def _predict_raw_impl(self, X, num_iteration: Optional[int] = None,
+                          start_iteration: int = 0,
+                          pred_early_stop: bool = False,
+                          pred_early_stop_freq: int = 10,
+                          pred_early_stop_margin: float = 10.0,
+                          _postprocess=None) -> np.ndarray:
         """Raw scores for new raw-feature data (binned via the train mappers;
         the analog of GBDT::PredictRaw, gbdt_prediction.cpp:13-53). The
         boost-from-average init score lives inside the first tree's leaves

@@ -238,6 +238,21 @@ class GrowAux(NamedTuple):
                              # the reference's ReduceScatter moves
                              # (data_parallel_tree_learner.cpp:184-186).
                              # 0 for the serial / feature learners.
+    sentinel: jax.Array = None  # f32 scalar numerics sentinel for the
+                             # HISTOGRAM PLANE: nonzero when the final
+                             # histogram state / per-leaf grad-hess sums /
+                             # leaf outputs contain NaN/Inf. Computed
+                             # IN-PROGRAM (so it sees what the Pallas/XLA
+                             # histogram kernels actually accumulated,
+                             # which the host-side gradient check cannot)
+                             # only when the ``numerics_sentinels`` static
+                             # is on; a constant 0 otherwise — zero cost
+                             # and a byte-identical program with the
+                             # guard off. The default exists ONLY so
+                             # 4-field GrowAux pickles from pre-sentinel
+                             # checkpoints (CEGB aux in state.pkl) still
+                             # unpickle; set_trainer_state normalizes the
+                             # None to a real f32 zero.
 
 
 class GrowState(NamedTuple):
@@ -471,7 +486,8 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
                      "hist_subtraction", "feature_block",
                      "feature_axis_name", "feature_shards", "voting",
                      "vote_top_k", "hist_dp", "sp_cols",
-                     "compaction_ladder", "hist_interpret"))
+                     "compaction_ladder", "hist_interpret",
+                     "numerics_sentinels"))
 def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
               feature_mask: jax.Array, missing_bin: jax.Array, *,
@@ -514,6 +530,7 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sp_default: jax.Array | None = None,
               compaction_ladder: tuple = (),
               hist_interpret: bool = False,
+              numerics_sentinels: bool = False,
               ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
     """Grow one tree. Returns (tree arrays, per-row leaf index, aux state).
 
@@ -1458,8 +1475,26 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         # global rows per tree across the row shards (each shard counted
         # only its local rows)
         rows_streamed = jax.lax.psum(rows_streamed, axis_name)
+    # histogram-plane numerics sentinel (see GrowAux.sentinel): judged on
+    # the FINAL grow state, in-program — the per-leaf grad/hess sums and
+    # outputs integrate every histogram the tree consumed (a NaN entering
+    # any pass lands in some leaf's sums), and the resident histogram
+    # state is checked directly where it exists (the blocked mode holds
+    # only a dummy). A constant 0 when the static is off, so the disarmed
+    # program is unchanged.
+    if numerics_sentinels:
+        bad = (jnp.any(~jnp.isfinite(state.leaf_sum_g))
+               | jnp.any(~jnp.isfinite(state.leaf_sum_h))
+               | jnp.any(~jnp.isfinite(state.leaf_output)))
+        if not blocked:
+            bad = bad | jnp.any(~jnp.isfinite(state.hist))
+        sentinel = bad.astype(jnp.float32)
+        if axis_name is not None:
+            sentinel = jax.lax.psum(sentinel, axis_name)
+    else:
+        sentinel = jnp.float32(0.0)
     # coll_bytes is already the per-device receive volume and identical on
     # every shard — no psum (a psum would scale it by the mesh size)
     return state.tree, state.leaf_id, GrowAux(state.used_split,
                                               state.row_used, rows_streamed,
-                                              state.coll_bytes)
+                                              state.coll_bytes, sentinel)

@@ -105,6 +105,21 @@ def build_histograms(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
     raise ValueError(f"unknown histogram method: {method}")
 
 
+def oom_fallback_method(method: str) -> str:
+    """Rung 2 of the OOM degradation ladder (models/gbdt.py
+    _maybe_degrade_oom): the minimum-footprint formulation of the same
+    histogram contraction. The Pallas kernels pin VMEM tiles and the
+    onehot formulations materialize a transient [C, F*B] one-hot per row
+    block; ``scatter`` allocates only the [L, F, B, S] output and updates
+    it in place — slow on TPU (sequential lowering) but the smallest
+    possible working set, which is the point of a degraded-but-alive run.
+    Quantized methods keep their exact-integer accumulation via
+    ``onehot_q8`` (scatter has no integer form — resolve_method's rule)."""
+    if method.endswith("_q8"):
+        return "onehot_q8"
+    return "scatter"
+
+
 def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     """Histogram subtraction trick: sibling = parent - child
     (reference: serial_tree_learner.cpp:311-320, feature_histogram.hpp:79)."""

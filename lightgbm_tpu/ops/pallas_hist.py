@@ -452,6 +452,15 @@ _tuned: dict = {}
 BLOCK_CANDIDATES = (1024, 2048, 4096, 8192)
 
 
+def oom_shrink_block(block: int) -> int:
+    """Rung 1 of the OOM degradation ladder: a histogram row block a
+    quarter the current size (floor 256 — below that the per-pass
+    overheads dominate and rung 2's formulation change is the right
+    lever). ``block=0`` (the per-method auto default) shrinks from the
+    kernel's 2048 default."""
+    return max(256, (block or 2048) // 4)
+
+
 def structural_tile_leaves(stats_channels: int = 3) -> int:
     """The leaf batch the kernel wants, by construction: the widest tile
     whose (leaf x stat) channels fit one 128-lane group. No measurement
@@ -508,7 +517,15 @@ def autotune_hist(binsT, num_bins: int, mode: str = "hilo",
             r = fn(subT, stats, lid, sel)
             float(jnp.sum(r))                    # sync via scalar fetch
             times[blk] = time.time() - t0
-        except Exception:                        # candidate unsupported
+        except Exception as e:                   # candidate unsupported
+            from ..utils import faults
+            if faults.is_resource_exhausted(e):
+                # a candidate block that exhausts VMEM/HBM is not an
+                # error — it is exactly what the sweep exists to avoid;
+                # name it so an operator can see the shape is memory-bound
+                from ..utils import log
+                log.info(f"pallas hist autotune: block {blk} skipped "
+                         f"(RESOURCE_EXHAUSTED at this shape)")
             continue
     if not times:
         out = {"block": 0, "tile_leaves": tile}

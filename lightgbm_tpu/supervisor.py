@@ -38,7 +38,13 @@ REJECTED by ``train(resume_from=...)``::
 Children run with ``LGBM_TPU_SUPERVISED=1``: a rank whose collective
 watchdog fires exits with ``WATCHDOG_EXIT_CODE`` (writing a JSON diagnosis
 the supervisor folds into its report) instead of raising, since a rank
-stuck inside a native collective cannot be unstuck from Python. One-shot
+stuck inside a native collective cannot be unstuck from Python. A rank
+the cross-rank integrity check (``integrity_check_period``) identifies as
+holding silently-diverged state exits with ``DIVERGENCE_EXIT_CODE`` the
+same way — the supervisor charges ITS restart budget (the divergence vote
+is hard evidence against that rank, unlike a watchdog exit) and restores
+it from the last valid checkpoint, shrinking it away once the budget is
+spent. One-shot
 ``LGBM_TPU_FAULT_*`` injections are stripped from relaunched incarnations
 (a kill-at-iteration-k fault would otherwise re-fire forever at the exact
 iteration the checkpoint resumes from); ``LGBM_TPU_RESTART_COUNT`` tells
@@ -138,7 +144,11 @@ def _read_diags(diag_dir: str) -> List[dict]:
     except OSError:
         return out
     for name in names:
-        if not name.startswith("watchdog_rank"):
+        # watchdog_rank*.json: collective-stall diagnoses;
+        # divergence_rank*.json: cross-rank integrity verdicts (the
+        # corrupt rank names itself + the fingerprint table before
+        # exiting with DIVERGENCE_EXIT_CODE)
+        if not name.startswith(("watchdog_rank", "divergence_rank")):
             continue
         try:
             with open(os.path.join(diag_dir, name)) as fh:
@@ -316,7 +326,9 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
                         + (" (watchdog)" if c ==
                            distributed.WATCHDOG_EXIT_CODE else
                            (" (spawn failed)" if c ==
-                            distributed.SPAWN_FAIL_EXIT_CODE else ""))
+                            distributed.SPAWN_FAIL_EXIT_CODE else
+                            (" (diverged)" if c ==
+                             distributed.DIVERGENCE_EXIT_CODE else "")))
                         for r, c in sorted(dead_codes.items()))
                     failure = f"gang member(s) died: {kinds}"
                     break
@@ -344,8 +356,12 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
         failures.append(rec)
         sus = {s for d in diags for s in (d.get("suspects") or [])}
         # ---- permanent-loss classification -> gang shrink
+        # a DIVERGENCE exit is hard evidence against the exiting rank (it
+        # held minority state by the gang's own vote), so like a kill/OOM
+        # it charges that rank's budget and shields collateral exits
         hard = {r for r, c in rec.exit_codes.items()
-                if c in (137, distributed.SPAWN_FAIL_EXIT_CODE)}
+                if c in (137, distributed.SPAWN_FAIL_EXIT_CODE,
+                         distributed.DIVERGENCE_EXIT_CODE)}
         for r in rec.failed_ranks:
             if r not in rec.exit_codes:
                 # incarnation timeout: ranks merely missing from results

@@ -49,6 +49,28 @@ a child process without touching its config):
                                       start" shape the supervisor answers
                                       with a gang SHRINK (env-driven only:
                                       it fires before a config exists)
+  LGBM_TPU_FAULT_FLIP_SCORE_RANK=r:k  flip ONE bit of rank r's train-score
+                                      cache right after iteration k
+                                      completes — the silent-corruption
+                                      shape (cosmic ray / bad DIMM / kernel
+                                      bug) the cross-rank divergence check
+                                      (distributed.check_model_integrity)
+                                      exists to catch
+  LGBM_TPU_FAULT_NAN_HIST_AT_ITER=k   poison one gradient value with NaN
+                                      INSIDE the compiled program at
+                                      iteration k — unlike NAN_GRAD (which
+                                      materializes gradients on host and
+                                      so unfuses the iteration), this one
+                                      is a traced injection the fused
+                                      path's in-program numerics sentinels
+                                      must catch
+  LGBM_TPU_FAULT_OOM_AT_ITER=k        raise a simulated RESOURCE_EXHAUSTED
+                                      from the boosting step at iteration
+                                      k, LGBM_TPU_FAULT_OOM_COUNT times
+                                      consecutively (default 1) — drives
+                                      the OOM degradation ladder
+                                      (models/gbdt.py _maybe_degrade_oom)
+                                      one rung per raise
 
 The rank-targeted forms resolve the process rank lazily through
 ``jax.process_index()`` so the plan can be built before distributed init.
@@ -79,6 +101,11 @@ class FaultPlan:
     nan_grad_at_iter: int = -1
     nan_grad_count: int = 8
     corrupt_checkpoint: bool = False
+    flip_score_rank: Optional[Tuple[int, int]] = None     # (rank, iter)
+    nan_hist_at_iter: int = -1
+    oom_at_iter: int = -1
+    oom_count: int = 1            # consecutive simulated OOM raises left
+                                  # (mutated by maybe_oom as they fire)
 
     @property
     def wants_nan_grad(self) -> bool:
@@ -136,6 +163,15 @@ def plan_from(config=None) -> Optional[FaultPlan]:
         nan_grad_at_iter=_env_int("LGBM_TPU_FAULT_NAN_GRAD_AT_ITER",
                                   int(get("fault_nan_grad_at_iter", -1))),
         nan_grad_count=_env_int("LGBM_TPU_FAULT_NAN_GRAD_COUNT", 8),
+        flip_score_rank=_env_rank_iter(
+            "LGBM_TPU_FAULT_FLIP_SCORE_RANK",
+            get("fault_flip_score_rank", "")),
+        nan_hist_at_iter=_env_int("LGBM_TPU_FAULT_NAN_HIST_AT_ITER",
+                                  int(get("fault_nan_hist_at_iter", -1))),
+        oom_at_iter=_env_int("LGBM_TPU_FAULT_OOM_AT_ITER",
+                             int(get("fault_oom_at_iter", -1))),
+        oom_count=_env_int("LGBM_TPU_FAULT_OOM_COUNT",
+                           int(get("fault_oom_count", 1))),
         corrupt_checkpoint=(
             # env, when set, OVERRIDES the param (in both directions, like
             # the integer faults): "1" arms, anything else disarms
@@ -150,17 +186,17 @@ def plan_from(config=None) -> Optional[FaultPlan]:
             and plan.kill_in_shard_write is None
             and plan.corrupt_shard < 0
             and plan.nan_grad_at_iter < 0
+            and plan.flip_score_rank is None
+            and plan.nan_hist_at_iter < 0
+            and plan.oom_at_iter < 0
             and not plan.corrupt_checkpoint):
         return None
     return plan
 
 
 def _process_rank() -> int:
-    import jax
-    try:
-        return int(jax.process_index())
-    except Exception:
-        return 0
+    from .. import distributed
+    return distributed.jax_rank()
 
 
 def _hard_exit(context: str) -> None:
@@ -275,6 +311,83 @@ def maybe_corrupt_shard(plan: Optional[FaultPlan], path: str,
     must then be treated as invalid by the prune/fallback logic."""
     if plan is not None and plan.corrupt_shard == rank:
         corrupt_file(path)
+
+
+def maybe_flip_score(plan: Optional[FaultPlan], iteration: int, score):
+    """Flip ONE bit (the lowest mantissa bit of element 0) of the armed
+    rank's train-score cache after iteration ``iteration`` completes —
+    the silent single-bit corruption the cross-rank divergence check must
+    attribute to exactly this rank. Returns the corrupted score array, or
+    None when the fault is not armed for (this rank, this iteration).
+    Involutory: applying it twice restores the original bits (the tests
+    use that to verify exactly one bit moved)."""
+    if plan is None or plan.flip_score_rank is None:
+        return None
+    if plan.flip_score_rank[1] != iteration \
+            or plan.flip_score_rank[0] != _process_rank():
+        return None
+    import jax.numpy as jnp
+    import numpy as np
+    arr = np.array(np.asarray(score, np.float32), copy=True)
+    flat = arr.reshape(-1).view(np.uint32)
+    flat[0] ^= np.uint32(1)
+    sys.stderr.write(f"[faults] flipping one score-cache bit on rank "
+                     f"{_process_rank()} after iteration {iteration}\n")
+    sys.stderr.flush()
+    return jnp.asarray(arr)
+
+
+def nan_hist_iter(plan: Optional[FaultPlan]) -> int:
+    """The iteration armed for the IN-PROGRAM NaN injection (-1 = off).
+    The fused step closes over this as a STATIC so the disarmed program is
+    byte-identical to a fault-free trace; the armed program compares the
+    traced iteration operand against it (models/gbdt.py _fused_step_fn)."""
+    return plan.nan_hist_at_iter if plan is not None else -1
+
+
+def maybe_nan_hist(plan: Optional[FaultPlan], iteration: int, g, h):
+    """Host-path twin of the in-program NaN injection: poison ONE gradient
+    value at the armed iteration (the unfused spelling of what
+    nan_hist_iter injects inside the fused program). Returns (g, h)."""
+    if plan is None or plan.nan_hist_at_iter != iteration:
+        return g, h
+    import jax.numpy as jnp
+    flat = g.reshape(-1).at[0].set(jnp.nan)
+    return flat.reshape(g.shape), h
+
+
+class SimulatedResourceExhausted(RuntimeError):
+    """Stands in for the backend's RESOURCE_EXHAUSTED XlaRuntimeError so
+    the OOM degradation ladder is exercisable on any host. The message
+    carries the literal token ``is_resource_exhausted`` matches on."""
+
+
+def maybe_oom(plan: Optional[FaultPlan], iteration: int) -> None:
+    """Raise a simulated RESOURCE_EXHAUSTED from the boosting step at the
+    armed iteration, ``oom_count`` consecutive times (the plan's counter
+    decrements per raise) — each raise drives the degradation ladder
+    down one rung before the step is retried."""
+    if plan is None or plan.oom_at_iter != iteration or plan.oom_count <= 0:
+        return
+    plan.oom_count -= 1
+    raise SimulatedResourceExhausted(
+        f"RESOURCE_EXHAUSTED: simulated histogram allocation failure at "
+        f"iteration {iteration} ({plan.oom_count} more armed)")
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """Whether an exception is an out-of-device-memory failure: the
+    backend's RESOURCE_EXHAUSTED XlaRuntimeError (compile-time VMEM/HBM
+    exhaustion and runtime allocation failures both carry the token), or
+    the fault harness's simulated stand-in. The classifier the OOM
+    degradation ladder gates on — it must never match unrelated errors,
+    so the match is on the specific allocator phrasings only."""
+    if isinstance(exc, SimulatedResourceExhausted):
+        return True
+    text = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in text
+            or "Out of memory" in text
+            or "Resource exhausted" in text)
 
 
 def maybe_fail_spawn(rank: int) -> None:

@@ -8,10 +8,14 @@ for f in "$(dirname "$0")"/test_*.py; do
   echo "=== $f"
   python -u -m pytest "$f" -q --no-header || fail=1
 done
-# supervisor gang-restart + elastic smoke (fast knobs, ~45 s): kill a rank
-# mid-iter -> relaunch from checkpoint -> bit-identical final model, then
-# fail a rank's spawn permanently -> gang shrinks to world size 1 and
-# completes (the shrink recorded in the SupervisorReport)
+# supervisor gang-restart + elastic + integrity smoke (fast knobs,
+# ~90 s): kill a rank mid-iter -> relaunch from checkpoint ->
+# bit-identical final model; fail a rank's spawn permanently -> gang
+# shrinks to world size 1 and completes (the shrink recorded in the
+# SupervisorReport); flip one score-cache bit on rank 1 of a 3-rank gang
+# -> the cross-rank divergence vote names exactly that rank (exit 95) ->
+# the supervisor restores the gang from the last valid checkpoint ->
+# training completes with model text bit-identical to the fault-free run
 echo "=== scripts/supervisor_smoke.py"
 python -u "$(dirname "$0")/../scripts/supervisor_smoke.py" || fail=1
 # Pallas histogram-kernel roofline smoke (fast knobs, ~30 s on CPU): runs

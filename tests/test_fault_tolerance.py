@@ -170,7 +170,13 @@ def test_corrupt_latest_falls_back_to_previous_valid(tmp_path, caplog):
     assert resumed.model_to_string() == full
 
 
+@pytest.mark.slow
 def test_truncated_state_and_manifest_fall_back(tmp_path):
+    """Slow: tier-1 sibling test_corrupt_latest_falls_back_to_previous_valid
+    exercises the same damaged-checkpoint -> fall-back-to-previous-valid
+    path (plus resume parity); this spelling adds the truncated-sidecar
+    and unparseable-manifest damage kinds and the nothing-valid ->
+    train-from-scratch exit."""
     X, y = _data()
     params = {**BASE, "objective": "regression"}
     ckdir = str(tmp_path / "ck")

@@ -111,7 +111,13 @@ def test_early_stopping(binary_example):
     assert booster.best_iteration <= 200
 
 
+@pytest.mark.slow
 def test_weights_change_model(rng):
+    """Slow: the weight plumbing stays tier-1 via
+    test_boosting_modes.py::test_goss_weights_exact_counts_under_ties
+    (weighted gradient scaling), test_sklearn.py::test_class_weight_balanced
+    (sample-weight end-to-end) and test_cli.py::test_cli_weight_side_file;
+    this spelling only adds the mean-shift sanity check."""
     n = 800
     X = rng.normal(size=(n, 4))
     y = (X[:, 0] > 0).astype(np.float64)
