@@ -20,6 +20,8 @@ from .callback import checkpoint as checkpoint_callback
 from .config import Config
 from .distributed import DistributedTimeoutError
 from .engine import CVBooster, cv, train
+from .serving import (ServeFrontend, ServeOverloadError, ServeSwapError,
+                      ServeTimeoutError)
 from .utils.log import register_logger
 
 __version__ = "0.1.0"
@@ -29,6 +31,8 @@ __all__ = [
     "register_logger", "early_stopping", "print_evaluation", "log_evaluation",
     "record_evaluation", "reset_parameter", "EarlyStopException",
     "checkpoint_callback", "DistributedTimeoutError",
+    "ServeFrontend", "ServeTimeoutError", "ServeOverloadError",
+    "ServeSwapError",
 ]
 
 

@@ -87,12 +87,17 @@ _NON_TRAINING_PARAMS = frozenset({
     # "oom_degrade" — but toggling the gate between runs must not reject
     # an otherwise-valid resume)
     "integrity_check_period", "hist_oom_fallback",
+    # serving-front-end knobs: batching/deadline/admission policy for the
+    # ServeFrontend — pure request-routing, never touches training
+    "serve_flush_ms", "serve_max_batch_rows", "serve_max_queue_rows",
+    "serve_deadline_ms",
     "fault_kill_at_iter", "fault_hang_at_iter", "fault_kill_in_ckpt_write",
     "fault_nan_grad_at_iter", "fault_corrupt_checkpoint",
     "fault_kill_rank_at_iter", "fault_hang_rank_at_iter",
     "fault_kill_in_shard_write", "fault_corrupt_shard",
     "fault_flip_score_rank", "fault_nan_hist_at_iter",
     "fault_oom_at_iter", "fault_oom_count",
+    "fault_slow_predict_ms", "fault_oom_at_predict",
 })
 
 

@@ -326,6 +326,14 @@ class Config:
     fault_nan_grad_at_iter: int = -1
     # flip bytes in each checkpoint's model text right after it is written
     fault_corrupt_checkpoint: bool = False
+    # sleep this many milliseconds inside EVERY predict dispatch (config
+    # twin of LGBM_TPU_FAULT_SLOW_PREDICT_MS) — the slow-dispatch shape
+    # the serving layer's deadlines and admission control must catch
+    fault_slow_predict_ms: float = 0.0
+    # raise a simulated RESOURCE_EXHAUSTED from the next N predict
+    # dispatches, process-wide (twin of LGBM_TPU_FAULT_OOM_AT_PREDICT) —
+    # drives the serve-side predict-chunk degradation rung
+    fault_oom_at_predict: int = 0
 
     # IO / dataset (config.h:604-800)
     max_bin: int = 255
@@ -472,6 +480,27 @@ class Config:
     # two-float (Kahan) f32 for backends without usable f64; float32 =
     # fastest, least precise
     predict_accum: str = "auto"
+
+    # Serving front end (lightgbm_tpu/serving.py ServeFrontend)
+    # how long the micro-batching dispatcher waits after the FIRST queued
+    # request before flushing the coalesced batch (the latency the
+    # batching may add to a lone request; a full batch flushes early)
+    serve_flush_ms: float = 2.0
+    # coalesced-batch row cap: a flush takes queued same-model requests in
+    # arrival order up to this many rows (one oversized request still
+    # dispatches alone — the engine chunks it internally)
+    serve_max_batch_rows: int = 8192
+    # admission-control cap on queued + in-flight rows: a request that
+    # would push past it is SHED with a retriable ServeOverloadError
+    # instead of growing the queue without bound (recorded in
+    # health_snapshot() / the serve_shed_count gauge); one request larger
+    # than the cap still admits on an idle frontend — it dispatches alone
+    # and the engine chunks it internally
+    serve_max_queue_rows: int = 65536
+    # default per-request deadline in milliseconds (0 = none): a request
+    # not answered in time raises a ServeTimeoutError naming the phase it
+    # died in (queue-wait vs dispatch); per-request deadline_ms overrides
+    serve_deadline_ms: float = 0.0
 
     def __post_init__(self):
         if self.seed is not None:

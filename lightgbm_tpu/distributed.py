@@ -1039,6 +1039,15 @@ def health_snapshot() -> dict:
         out["collective_deadline"] = h.watchdog.deadline
     if _degradations:
         out["degradations"] = list(_degradations)
+    # serving-layer gauges (queue depth, in-flight rows, shed/timeout
+    # counts, latency percentiles — lightgbm_tpu/serving.py): surfaced
+    # here so an operator reading a manifest or bench JSON sees the serve
+    # plane's health next to the training plane's
+    from .utils import profiling
+    serve = {k: v for k, v in profiling.gauges().items()
+             if k.startswith("serve_")}
+    if serve:
+        out["serve"] = serve
     return out
 
 
@@ -1059,13 +1068,21 @@ def health_snapshot() -> dict:
 _degradations: List[dict] = []
 
 
-def record_degradation(event: dict) -> None:
-    """Record one degradation event (kind/iteration/level/action/error)."""
+def record_degradation(event: dict) -> dict:
+    """Record one degradation event (kind/iteration/level/action/error).
+    Returns the STORED dict (the caller's is copied), so episode-style
+    callers (serve shedding) can update one recorded event in place
+    instead of growing the log per occurrence."""
     event = dict(event)
     event["seq"] = len(_degradations)
     _degradations.append(event)
     from .utils import profiling
-    profiling.set_gauge("oom_degradations", float(len(_degradations)))
+    # the gauge is the OOM ladder's (PR 8 failure-mode table) — serve
+    # shed/swap events share the log but must not inflate it
+    profiling.set_gauge("oom_degradations",
+                        float(sum(1 for d in _degradations
+                                  if "oom" in d.get("kind", ""))))
+    return event
 
 
 def degradations() -> List[dict]:

@@ -624,12 +624,69 @@ class LoadedGBDT:
         return X
 
     # ----------------------------------------------------------- predict
+    _oom_predict_chunk = 0       # predict-chunk degradation rung (serve OOM)
+
     def predict_raw(self, X, num_iteration: Optional[int] = None,
                     start_iteration: int = 0,
                     pred_early_stop: bool = False,
                     pred_early_stop_freq: int = 10,
                     pred_early_stop_margin: float = 10.0) -> np.ndarray:
         X = self._check_features(X)
+        kwargs = dict(num_iteration=num_iteration,
+                      start_iteration=start_iteration,
+                      pred_early_stop=pred_early_stop,
+                      pred_early_stop_freq=pred_early_stop_freq,
+                      pred_early_stop_margin=pred_early_stop_margin)
+        # same predict-chunk degradation rung as GBDT.predict_raw, so a
+        # hot-swapped file-loaded model honors the serving layer's
+        # OOM-rides-the-ladder contract: a RESOURCE_EXHAUSTED shrinks the
+        # chunk and the request is retried and ANSWERED (chunking the
+        # host loop is numerics-exact — rows never interact)
+        while True:
+            try:
+                chunk = self._oom_predict_chunk
+                if chunk and X.shape[0] > chunk:
+                    return np.concatenate(
+                        [self._predict_raw_chunk(X[a:a + chunk], **kwargs)
+                         for a in range(0, X.shape[0], chunk)], axis=0)
+                return self._predict_raw_chunk(X, **kwargs)
+            except BaseException as e:    # noqa: BLE001 — reclassified
+                if not self._maybe_degrade_predict_oom(e):
+                    raise
+
+    def _maybe_degrade_predict_oom(self, exc: BaseException) -> bool:
+        """The GBDT predict-OOM rung for file-loaded models: halve the
+        effective predict chunk (floor 16k rows), record the event, retry.
+        Bounded — once the floor is reached the error re-raises."""
+        from .. import distributed
+        from ..utils import faults, profiling
+        nxt = faults.next_predict_chunk(
+            exc, self._oom_predict_chunk,
+            getattr(self.config, "hist_oom_fallback", True))
+        if nxt is None:
+            return False
+        self._oom_predict_chunk = nxt
+        action = f"predict_chunk_rows -> {self._oom_predict_chunk}"
+        distributed.record_degradation({
+            "kind": "oom_predict", "iteration": -1, "level": 0,
+            "action": action, "error": str(exc)[:200]})
+        profiling.set_gauge("predict_oom_chunk_rows",
+                            float(self._oom_predict_chunk))
+        log.warning(f"RESOURCE_EXHAUSTED in loaded-model predict: "
+                    f"degrading ({action}) and retrying")
+        return True
+
+    def _predict_raw_chunk(self, X, num_iteration=None, start_iteration=0,
+                           pred_early_stop=False, pred_early_stop_freq=10,
+                           pred_early_stop_margin=10.0) -> np.ndarray:
+        from ..utils import faults
+        sf = faults.serve_faults(self.config)
+        if sf is not None:
+            # same serve-side injection points as GBDT._predict_raw_impl,
+            # so file-loaded models behave identically under the serving
+            # layer's fault drills (serve_smoke hot-swaps to one)
+            faults.maybe_slow_predict(sf)
+            faults.maybe_oom_predict(sf)
         k = self.num_tree_per_iteration
         total = self.num_iteration
         if num_iteration is None or num_iteration <= 0:

@@ -19,6 +19,7 @@ prediction) and as host ``HostTree`` objects for model IO/SHAP.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -216,6 +217,11 @@ class GBDT:
         # the stacked pytree it was built from, so a stacked-cache refresh
         # (new trees, shuffle, rollback, restore) invalidates it by identity
         self._engine_cache: Dict[int, Tuple[TreeArrays, object]] = {}
+        # guards engine-cache fill/eviction: two serve threads first-
+        # touching a booster used to both build an engine and race the
+        # bounded eviction (reentrant — _predict_engine can re-enter via
+        # the stacked-cache refresh)
+        self._engine_lock = threading.RLock()
         self.valid_sets: List[Dataset] = []
         self.valid_names: List[str] = []
         self._valid_scores: List[jax.Array] = []
@@ -312,6 +318,22 @@ class GBDT:
     _fault_plan = None           # set per-train (utils/faults injection)
     _bag_stale = False           # fused iterations draw bagging in-program;
                                  # the host mask re-derives on next use
+    _serve_mode = False          # ServeFrontend registration flips it on:
+                                 # engines built for this booster keep
+                                 # donated per-bucket serve buffers
+
+    def enable_serve_mode(self, on: bool = True) -> None:
+        """Serving mode for this booster's inference engines: steady-state
+        predicts re-use donated per-bucket device buffers (bin matrix +
+        carry) instead of allocating per call — see
+        predict_engine._serve_chunk. Applied to already-cached engines
+        too (the frontend may register a booster that has predicted)."""
+        self._serve_mode = bool(on)
+        with self._engine_lock:
+            for _, eng in self._engine_cache.values():
+                eng.serve_mode = self._serve_mode
+                if not self._serve_mode:
+                    eng.release_serve_slots()
 
     # ------------------------------------------------------------ setup
     def _init_train(self, train_set: Dataset) -> None:
@@ -1761,15 +1783,18 @@ class GBDT:
         hist-block/scatter rungs a later training OOM may still need."""
         from .. import distributed
         from ..utils import faults, profiling
-        if not self.config.hist_oom_fallback \
-                or not faults.is_resource_exhausted(exc):
+        nxt = faults.next_predict_chunk(
+            exc, self._oom_predict_chunk or self.config.predict_chunk_rows,
+            self.config.hist_oom_fallback)
+        if nxt is None:
             return False
-        cur = self._oom_predict_chunk \
-            or self.config.predict_chunk_rows or (1 << 22)
-        if cur <= (1 << 14):
-            return False
-        self._oom_predict_chunk = max(1 << 14, cur // 2)
-        self._engine_cache.clear()
+        with self._engine_lock:
+            # chunk update + cache clear under the engine lock: a
+            # concurrent _predict_engine fill must not read the old chunk
+            # and re-publish a stale engine after this clear (the retry
+            # would OOM again and burn an extra ladder rung)
+            self._oom_predict_chunk = nxt
+            self._engine_cache.clear()
         action = f"predict_chunk_rows -> {self._oom_predict_chunk}"
         distributed.record_degradation({
             "kind": "oom_predict", "iteration": int(self.iter),
@@ -2519,35 +2544,38 @@ class GBDT:
         cache, so anything that refreshes the stack (new trees, shuffle,
         rollback, checkpoint restore) rebuilds the engine."""
         from .predict_engine import PredictEngine
-        stacked = self._stacked(num_iteration)
-        if stacked is None:
-            return None
-        nt = int(stacked.leaf_value.shape[0])
-        hit = self._engine_cache.get(nt)
-        if hit is not None and hit[0] is stacked:
-            return hit[1]
-        cfg = self.config
-        biases = None
-        if len(self.tree_bias) >= nt:
-            b = np.asarray(self.tree_bias[:nt], np.float64)
-            if b.size and np.any(b):
-                biases = b
-        chunk = cfg.predict_chunk_rows
-        if self._oom_predict_chunk:
-            # OOM ladder rung 3: bound the serving program's resident rows
-            chunk = self._oom_predict_chunk if not chunk \
-                else min(chunk, self._oom_predict_chunk)
-        eng = PredictEngine(
-            stacked, self.num_tree_per_iteration, nt,
-            self._ensemble_depth(nt), biases=biases,
-            accum=cfg.predict_accum,
-            bucket_min_rows=cfg.predict_bucket_min_rows,
-            chunk_rows=chunk,
-            sharded=cfg.predict_sharded)
-        if len(self._engine_cache) >= 2:
-            self._engine_cache.pop(next(iter(self._engine_cache)))
-        self._engine_cache[nt] = (stacked, eng)
-        return eng
+        with self._engine_lock:
+            stacked = self._stacked(num_iteration)
+            if stacked is None:
+                return None
+            nt = int(stacked.leaf_value.shape[0])
+            hit = self._engine_cache.get(nt)
+            if hit is not None and hit[0] is stacked:
+                return hit[1]
+            cfg = self.config
+            biases = None
+            if len(self.tree_bias) >= nt:
+                b = np.asarray(self.tree_bias[:nt], np.float64)
+                if b.size and np.any(b):
+                    biases = b
+            chunk = cfg.predict_chunk_rows
+            if self._oom_predict_chunk:
+                # OOM ladder rung 3: bound the serving program's resident
+                # rows
+                chunk = self._oom_predict_chunk if not chunk \
+                    else min(chunk, self._oom_predict_chunk)
+            eng = PredictEngine(
+                stacked, self.num_tree_per_iteration, nt,
+                self._ensemble_depth(nt), biases=biases,
+                accum=cfg.predict_accum,
+                bucket_min_rows=cfg.predict_bucket_min_rows,
+                chunk_rows=chunk,
+                sharded=cfg.predict_sharded)
+            eng.serve_mode = self._serve_mode
+            if len(self._engine_cache) >= 2:
+                self._engine_cache.pop(next(iter(self._engine_cache)))
+            self._engine_cache[nt] = (stacked, eng)
+            return eng
 
     def _convert_output_jit(self):
         """The objective's output conversion as ONE jitted program (the
@@ -2685,6 +2713,15 @@ class GBDT:
         early exit — rows whose margin exceeds the threshold at a check
         round stop accumulating further trees (reference:
         prediction_early_stop.cpp:25-75, hook in gbdt_prediction.cpp)."""
+        from ..utils import faults as faults_mod
+        sf = faults_mod.serve_faults(self.config)
+        if sf is not None:
+            # serve-side injection points (deterministic, re-read per
+            # dispatch): a traced delay forcing deadline/shed paths, and a
+            # simulated RESOURCE_EXHAUSTED the predict-chunk degradation
+            # rung (predict_raw's retry loop) must rescue
+            faults_mod.maybe_slow_predict(sf)
+            faults_mod.maybe_oom_predict(sf)
         X = self._prep_predict_X(X)
         if self.config.linear_tree or self.train_set.bundles is not None:
             # raw-feature prediction via the model-space trees: linear leaves

@@ -46,6 +46,7 @@ matrices, with per-tree bias subtraction) and ``predict_leaf``.
 from __future__ import annotations
 
 import functools
+import threading
 from contextlib import nullcontext
 from typing import Dict, Optional, Tuple
 
@@ -56,6 +57,16 @@ import numpy as np
 from .tree import TreeArrays, predict_leaf_bins_depth
 
 ACCUM_MODES = ("float64", "compensated", "float32")
+
+# Trace-time compile counters: the core functions' Python bodies run
+# exactly once per jit-cache miss (a trace == an XLA compile of a new
+# program), so these count real compiles. The observable behind the
+# serving suite's regression tests: concurrent first-touch of one shape
+# bucket compiles exactly once (the engine lock serializes it), and a
+# hot-swapped model version with the same statics/bucket re-uses the
+# already-compiled programs (delta == 0) — the jitted entries are
+# MODULE-level, shared across every engine and model version.
+TRACE_COUNTS: Dict[str, int] = {"accum": 0, "leaves": 0, "refill": 0}
 
 
 def _x64_ctx():
@@ -119,6 +130,7 @@ def _accum_core(stacked, class_of, biases, bins, missing_bin, carry, active,
     No multiply feeds the accumulation adds (the active mask is applied
     with a select, not a 0/1 multiply), so XLA cannot FMA-contract a
     rounding away — see the PR 3 parity lesson in _apply_score_delta."""
+    TRACE_COUNTS["accum"] += 1          # trace-time only: counts compiles
     n = bins.shape[0]
     if init_zero:
         if accum == "compensated":
@@ -168,6 +180,8 @@ _accum_jit = jax.jit(_accum_core, static_argnames=(
 
 
 def _leaves_core(stacked, bins, missing_bin, *, depth: int):
+    TRACE_COUNTS["leaves"] += 1         # trace-time only: counts compiles
+
     def step(_, tree):
         return _, predict_leaf_bins_depth(tree, bins, missing_bin, depth)
     _, leaves = jax.lax.scan(step, 0, stacked)
@@ -175,6 +189,68 @@ def _leaves_core(stacked, bins, missing_bin, *, depth: int):
 
 
 _leaves_jit = jax.jit(_leaves_core, static_argnames=("depth",))
+
+
+# ------------------------------------------------- donated serve programs
+# Steady-state serving re-uses two device buffers per shape bucket — the
+# padded bin matrix and the accumulation carry — via buffer DONATION, so
+# the serve loop never re-allocates its large operands: each flush writes
+# the new rows into the donated bin buffer and the accumulation writes its
+# output into the donated carry buffer (with ``init_zero`` the incoming
+# carry VALUE is ignored — only its buffer is recycled). Donation is a
+# no-op on backends without input-output aliasing (CPU), where passing
+# donate_argnums would only emit per-program warnings — so the jits are
+# built lazily, once the backend is known, with donation enabled only
+# where it is implemented. Numerics are identical either way, which is
+# what keeps the donated path CPU-testable.
+
+_serve_jits: Dict[str, object] = {}
+_serve_jit_lock = threading.Lock()
+
+# first-dispatch serialization is MODULE-level to match the jitted
+# programs it guards (_accum_jit is shared by every engine): two engines
+# of the same ensemble shape first-touching one bucket concurrently must
+# also compile it exactly once, which a per-engine lock cannot give
+_first_dispatch_lock = threading.RLock()
+_compiled_keys: set = set()
+
+
+def _donation_ok() -> bool:
+    return jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+
+
+def _refill_core(buf, rows):
+    TRACE_COUNTS["refill"] += 1         # trace-time only: counts compiles
+    # full-buffer overwrite that CONSUMES buf: XLA aliases the output to
+    # the donated input buffer (a bare `return rows` would leave the
+    # donated buffer unused — no reuse, and a warning per program)
+    return jax.lax.dynamic_update_slice(buf, rows.astype(buf.dtype), (0, 0))
+
+
+def _serve_refill_jit():
+    with _serve_jit_lock:
+        prog = _serve_jits.get("refill")
+        if prog is None:
+            prog = jax.jit(_refill_core,
+                           donate_argnums=(0,) if _donation_ok() else ())
+            _serve_jits["refill"] = prog
+        return prog
+
+
+def _serve_accum_jit():
+    """The accumulation program with the carry operand (positional arg 5)
+    donated — one jit entry shared by every engine and model version, so
+    same-bucket traffic across hot swaps hits the same compiled programs."""
+    with _serve_jit_lock:
+        prog = _serve_jits.get("accum")
+        if prog is None:
+            prog = jax.jit(
+                _accum_core,
+                static_argnames=("depth", "k", "use_bias", "use_active",
+                                 "accum", "init_zero"),
+                donate_argnums=(5,) if _donation_ok() else ())
+            _serve_jits["accum"] = prog
+        return prog
 
 
 class PredictEngine:
@@ -208,6 +284,19 @@ class PredictEngine:
         # shapes + statics => guaranteed jit cache hit, no recompile)
         self._programs: Dict[Tuple, bool] = {}
         self._shard_programs: Dict[Tuple, object] = {}
+        # guards every cache fill (device operands, program keys, serve
+        # slots): concurrent FIRST calls from serve threads used to race
+        # the fill and double-compile (or publish a half-built operand) —
+        # the first dispatch of each new program key now runs under the
+        # lock, warm traffic takes the lock-free fast path (reentrant:
+        # accumulate -> _range_operands -> _dev nests)
+        self._lock = threading.RLock()
+        # serving mode (set by serving.ServeFrontend via
+        # GBDT.enable_serve_mode): steady-state predicts of one chunk
+        # re-use donated per-bucket device buffers instead of allocating
+        # a padded bin matrix + carry per call (see _serve_chunk)
+        self.serve_mode = False
+        self._serve_slots: Dict[int, dict] = {}
 
     # ------------------------------------------------------------ shapes
     def bucket_rows(self, n: int) -> int:
@@ -241,8 +330,11 @@ class PredictEngine:
     def _dev(self, key, build):
         hit = self._dev_cache.get(key)
         if hit is None:
-            hit = build()
-            self._dev_cache[key] = hit
+            with self._lock:
+                hit = self._dev_cache.get(key)
+                if hit is None:
+                    hit = build()
+                    self._dev_cache[key] = hit
         return hit
 
     def _range_operands(self, a: int, b: int, use_bias: bool):
@@ -273,23 +365,27 @@ class PredictEngine:
         prog = self._shard_programs.get(key)
         if prog is not None:
             return prog
-        from jax.sharding import PartitionSpec as P
-        from ..parallel.learners import _shard_map
-        mesh, axis = self._mesh_axis()
-        row = P(axis)
-        row2 = P(axis, None)
-        carry_spec = row if self.k == 1 else row2
-        use_bias = statics["use_bias"]
-        use_active = statics["use_active"]
-        init_zero = statics["init_zero"]
-        in_specs = (P(), P(), P(), row2, P(),
-                    P() if init_zero else carry_spec,
-                    row if use_active else P())
-        prog = jax.jit(_shard_map(
-            functools.partial(_accum_core, **statics),
-            mesh=mesh, in_specs=in_specs, out_specs=carry_spec))
-        self._shard_programs[key] = prog
-        return prog
+        with self._lock:
+            prog = self._shard_programs.get(key)
+            if prog is not None:
+                return prog
+            from jax.sharding import PartitionSpec as P
+            from ..parallel.learners import _shard_map
+            mesh, axis = self._mesh_axis()
+            row = P(axis)
+            row2 = P(axis, None)
+            carry_spec = row if self.k == 1 else row2
+            use_bias = statics["use_bias"]
+            use_active = statics["use_active"]
+            init_zero = statics["init_zero"]
+            in_specs = (P(), P(), P(), row2, P(),
+                        P() if init_zero else carry_spec,
+                        row if use_active else P())
+            prog = jax.jit(_shard_map(
+                functools.partial(_accum_core, **statics),
+                mesh=mesh, in_specs=in_specs, out_specs=carry_spec))
+            self._shard_programs[key] = prog
+            return prog
 
     def _upload_rows(self, arr: np.ndarray, sharded: bool):
         """Host array -> device, placed row-sharded over the mesh when the
@@ -353,15 +449,37 @@ class PredictEngine:
             statics = dict(depth=self.depth, k=self.k, use_bias=use_bias,
                            use_active=active is not None, accum=self.accum,
                            init_zero=carry is None)
-            key = ("accum", bins_dev.shape, b - a, self.sharded,
+            # the key carries the stacked operand's full shape, not just
+            # the tree count: two ensembles with equal T but different
+            # max leaf width are DIFFERENT jit entries, and the
+            # first-dispatch serialization below must know it
+            key = ("accum", bins_dev.shape, b - a,
+                   tuple(np.shape(stacked.leaf_value)), self.sharded,
                    tuple(sorted(statics.items())))
+
+            def dispatch():
+                if self.sharded:
+                    prog = self._shard_program(key, statics)
+                    return prog(stacked, class_of, biases, bins_dev,
+                                missing_bin, carry, active)
+                return _accum_jit(stacked, class_of, biases, bins_dev,
+                                  missing_bin, carry, active, **statics)
+
+            if key not in _compiled_keys:
+                # serialize the FIRST dispatch of each new program key:
+                # jax's jit cache lookup-then-trace is not atomic, so two
+                # threads first-touching one shape bucket — from the same
+                # engine or from two same-shape engines — would both miss
+                # and compile it twice. Warm traffic (key present =>
+                # program compiled) stays lock-free.
+                with _first_dispatch_lock:
+                    if key not in _compiled_keys:
+                        out = dispatch()
+                        _compiled_keys.add(key)
+                        self._programs[key] = True
+                        return out
             self._programs[key] = True
-            if self.sharded:
-                prog = self._shard_program(key, statics)
-                return prog(stacked, class_of, biases, bins_dev,
-                            missing_bin, carry, active)
-            return _accum_jit(stacked, class_of, biases, bins_dev,
-                              missing_bin, carry, active, **statics)
+            return dispatch()
 
     def fetch(self, carry, n: int) -> np.ndarray:
         """Slice off the row padding and fetch the result — the ONLY
@@ -394,6 +512,12 @@ class PredictEngine:
     def _predict_chunk(self, bins, missing_bin, base, postprocess,
                        tree_range, use_bias) -> np.ndarray:
         n = bins.shape[0]
+        if (self.serve_mode and base is None and not self.sharded
+                and self.T > 0 and not isinstance(bins, jax.Array)
+                and (tree_range is None
+                     or tuple(tree_range) == (0, self.T))):
+            return self._serve_chunk(bins, missing_bin, postprocess,
+                                     use_bias)
         bucket = self.bucket_rows(n)
         bins_dev = self.prepare_bins(bins, bucket)
         carry = self.make_carry(base, bucket)
@@ -406,6 +530,99 @@ class PredictEngine:
                 # globally — the dtype the legacy host conversion returned)
                 return np.asarray(jax.device_get(postprocess(s)[:n]))
         return self.fetch(carry, n)
+
+    # ----------------------------------------------------- serve (donated)
+    def _fresh_carry(self, bucket: int):
+        """Zero carry buffer in the accumulation dtype — the cold seed of
+        a serve slot (its VALUE is ignored under ``init_zero``; only its
+        buffer is donated and recycled). Caller holds the x64 scope."""
+        shape = (bucket,) if self.k == 1 else (bucket, self.k)
+        if self.accum == "compensated":
+            return (jnp.zeros(shape, jnp.float32),
+                    jnp.zeros(shape, jnp.float32))
+        dt = jnp.float64 if self.accum == "float64" else jnp.float32
+        return jnp.zeros(shape, dt)
+
+    def _serve_chunk(self, bins, missing_bin, postprocess,
+                     use_bias) -> np.ndarray:
+        """Steady-state serving predict of one host-bin chunk: the padded
+        bin matrix and the carry live in per-bucket slots whose device
+        buffers are DONATED back to the next flush, so the serve loop's
+        large allocations happen once per bucket, not once per call.
+        Bit-identical to the ordinary chunk path — the host staging array
+        keeps rows beyond the current batch at zero (exactly np.pad), and
+        per-row accumulation never reads another row. Runs under the
+        engine lock for its whole duration: a donated buffer is invalid
+        the moment the next program consumes it, so two threads in one
+        slot would read freed buffers — with the lock they serialize."""
+        n = bins.shape[0]
+        bucket = self.bucket_rows(n)
+        with self._lock, _x64_scope(self.accum):
+            stacked, class_of, biases = self._range_operands(
+                0, self.T, use_bias)
+            use_bias = biases is not None
+            statics = dict(depth=self.depth, k=self.k, use_bias=use_bias,
+                           use_active=False, accum=self.accum,
+                           init_zero=True)
+            slot = self._serve_slots.get(bucket)
+            if slot is not None and (
+                    slot["staging"].shape[1] != bins.shape[1]
+                    or slot["staging"].dtype != bins.dtype):
+                slot = None          # feature width/dtype changed: go cold
+            skey = ("serve", (bucket, bins.shape[1]),
+                    tuple(np.shape(stacked.leaf_value)),
+                    bool(slot is None), tuple(sorted(statics.items())))
+            # the serve programs are module-level jits too: their FIRST
+            # dispatch per signature takes the same module lock as
+            # accumulate's — two same-shape engines (two frontends) must
+            # compile each serve program exactly once. Safe with the held
+            # engine lock: serve engines are never sharded, so no path
+            # acquires an engine lock while holding the module lock.
+            guard = _first_dispatch_lock if skey not in _compiled_keys \
+                else nullcontext()
+            try:
+                with guard:
+                    if slot is None:
+                        staging = np.zeros((bucket, bins.shape[1]),
+                                           bins.dtype)
+                        staging[:n] = bins
+                        bins_dev = jnp.asarray(staging)
+                        carry = self._fresh_carry(bucket)
+                    else:
+                        staging = slot["staging"]
+                        staging[:n] = bins
+                        if slot["rows"] > n:
+                            # stale rows from the previous (larger) batch
+                            # must read as padding zeros, exactly np.pad
+                            staging[n:slot["rows"]] = 0
+                        bins_dev = _serve_refill_jit()(slot["bins"],
+                                                       staging)
+                        carry = slot["carry"]
+                    self._programs[skey] = True
+                    carry = _serve_accum_jit()(stacked, class_of, biases,
+                                               bins_dev, missing_bin,
+                                               carry, None, **statics)
+                    _compiled_keys.add(skey)
+                self._serve_slots[bucket] = {
+                    "staging": staging, "bins": bins_dev, "carry": carry,
+                    "rows": n}
+            except BaseException:
+                # donation may have invalidated the old buffers mid-call
+                # (e.g. a RESOURCE_EXHAUSTED between the refill and the
+                # accumulate): drop the slot so the next call goes cold
+                self._serve_slots.pop(bucket, None)
+                raise
+            if postprocess is not None:
+                s = carry[0] if self.accum == "compensated" else carry
+                return np.asarray(jax.device_get(postprocess(s)[:n]))
+            return self.fetch(carry, n)
+
+    def release_serve_slots(self) -> None:
+        """Drop the donated per-bucket serve buffers (the owning frontend
+        closed): staging arrays and device bins/carry go back to the
+        allocator; the next serve-mode predict simply goes cold."""
+        with self._lock:
+            self._serve_slots.clear()
 
     # ------------------------------------------------------------- leaves
     def leaves(self, bins, missing_bin,

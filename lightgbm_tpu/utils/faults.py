@@ -71,6 +71,24 @@ a child process without touching its config):
                                       the OOM degradation ladder
                                       (models/gbdt.py _maybe_degrade_oom)
                                       one rung per raise
+  LGBM_TPU_FAULT_SLOW_PREDICT_MS=ms   sleep ``ms`` milliseconds inside
+                                      every predict dispatch (the slow-
+                                      dispatch shape — tunnel stall, noisy
+                                      neighbor — the serving layer's
+                                      per-request deadlines and admission
+                                      control must answer; serving.py's
+                                      deadline/shed tests arm it)
+  LGBM_TPU_FAULT_OOM_AT_PREDICT=c     raise a simulated RESOURCE_EXHAUSTED
+                                      from the next ``c`` predict
+                                      dispatches PROCESS-WIDE (the fired
+                                      count persists across the fresh
+                                      fault plans each predict call
+                                      builds, so the ladder's retry loop
+                                      terminates) — drives the serve-side
+                                      predict-chunk degradation rung
+                                      (models/gbdt.py
+                                      _maybe_degrade_predict_oom) without
+                                      touching the training rungs
 
 The rank-targeted forms resolve the process rank lazily through
 ``jax.process_index()`` so the plan can be built before distributed init.
@@ -82,6 +100,7 @@ from __future__ import annotations
 
 import os
 import sys
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional, Tuple
@@ -116,6 +135,14 @@ def _env_int(name: str, default: int) -> int:
     v = os.environ.get(name, "")
     try:
         return int(v) if v != "" else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "")
+    try:
+        return float(v) if v != "" else default
     except ValueError:
         return default
 
@@ -388,6 +415,91 @@ def is_resource_exhausted(exc: BaseException) -> bool:
     return ("RESOURCE_EXHAUSTED" in text
             or "Out of memory" in text
             or "Resource exhausted" in text)
+
+
+# ------------------------------------------------------------ serve faults
+# Serve-side injection points (see lightgbm_tpu/serving.py). Unlike the
+# training faults these are re-read on EVERY predict dispatch (a fresh
+# tiny plan per call, two env lookups + two attribute reads when
+# disarmed), because serve tests arm/disarm them around individual
+# requests without rebuilding the booster.
+
+@dataclass
+class ServeFaults:
+    slow_predict_ms: float = 0.0   # sleep inside every predict dispatch
+    oom_predicts: int = 0          # simulated OOMs to raise, process-wide
+
+
+# predict-OOM raises fired so far in this process: the budget lives HERE
+# (module state) rather than on the plan, because a fresh plan is built
+# per predict call — a per-plan counter would re-arm on every ladder
+# retry and loop the rescue forever. Check-and-increment runs under a
+# lock: concurrent serve dispatches must not both pass the budget check
+# and burn two ladder rungs for a budget of one.
+_predict_oom_fired = 0
+_predict_oom_lock = threading.Lock()
+
+
+def serve_faults(config=None) -> Optional[ServeFaults]:
+    """Build the active serve-side fault plan from config fields
+    overridden by the LGBM_TPU_FAULT_* environment; None when nothing is
+    armed (the common case — kept to two env reads)."""
+    get = (lambda k, d: getattr(config, k, d)) if config is not None \
+        else (lambda k, d: d)
+    slow = _env_float("LGBM_TPU_FAULT_SLOW_PREDICT_MS",
+                      float(get("fault_slow_predict_ms", 0.0)))
+    ooms = _env_int("LGBM_TPU_FAULT_OOM_AT_PREDICT",
+                    int(get("fault_oom_at_predict", 0)))
+    if slow <= 0 and ooms <= 0:
+        return None
+    return ServeFaults(slow_predict_ms=slow, oom_predicts=ooms)
+
+
+def maybe_slow_predict(sf: Optional[ServeFaults]) -> None:
+    """Delay inside the predict dispatch path — forces requests past
+    their deadlines and backs the queue up into admission control."""
+    if sf is not None and sf.slow_predict_ms > 0:
+        time.sleep(sf.slow_predict_ms / 1e3)
+
+
+def maybe_oom_predict(sf: Optional[ServeFaults]) -> None:
+    """Raise a simulated RESOURCE_EXHAUSTED from the predict dispatch
+    while the armed budget has raises left (process-wide fired counter,
+    see _predict_oom_fired) — each raise drives the predict-chunk
+    degradation rung once before the call is retried."""
+    global _predict_oom_fired
+    if sf is None or sf.oom_predicts <= 0:
+        return
+    with _predict_oom_lock:
+        if _predict_oom_fired >= sf.oom_predicts:
+            return
+        _predict_oom_fired += 1
+        left = sf.oom_predicts - _predict_oom_fired
+    raise SimulatedResourceExhausted(
+        f"RESOURCE_EXHAUSTED: simulated predict allocation failure "
+        f"({left} more armed)")
+
+
+def reset_predict_oom() -> None:
+    """Re-arm the predict-OOM budget (tests call this between scenarios)."""
+    global _predict_oom_fired
+    _predict_oom_fired = 0
+
+
+def next_predict_chunk(exc: BaseException, cur: int,
+                       hist_oom_fallback: bool = True) -> Optional[int]:
+    """Predict-OOM ladder arithmetic, shared by GBDT and LoadedGBDT
+    (`_maybe_degrade_predict_oom` in models/gbdt.py and io/model_text.py
+    — ONE place owns the start/floor/halving so the two rungs cannot
+    drift): the halved chunk to retry with, or None when the rung must
+    not fire (gate off, not RESOURCE_EXHAUSTED, or the 16k-row floor is
+    already reached — the caller then re-raises)."""
+    if not hist_oom_fallback or not is_resource_exhausted(exc):
+        return None
+    cur = cur or (1 << 22)
+    if cur <= (1 << 14):
+        return None
+    return max(1 << 14, cur // 2)
 
 
 def maybe_fail_spawn(rank: int) -> None:

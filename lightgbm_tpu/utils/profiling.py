@@ -75,6 +75,17 @@ def set_gauge(name: str, value: float) -> None:
     _gauges[name] = float(value)
 
 
+def inc_gauge(name: str, delta: float = 1.0) -> float:
+    """Increment a counting gauge (serve shed/timeout counts) and return
+    the new value. Single dict read-modify-write under the GIL — racing
+    increments from serve caller threads can in principle lose a count,
+    which is acceptable for health telemetry (the authoritative counts
+    live on the ServeFrontend, behind its lock)."""
+    v = _gauges.get(name, 0.0) + float(delta)
+    _gauges[name] = v
+    return v
+
+
 def gauges() -> Dict[str, float]:
     """Current gauge values (supervisor restarts, heartbeat ages, ...)."""
     return dict(_gauges)

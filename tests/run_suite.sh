@@ -24,4 +24,16 @@ python -u "$(dirname "$0")/../scripts/supervisor_smoke.py" || fail=1
 echo "=== scripts/kernel_bench.py"
 python -u "$(dirname "$0")/../scripts/kernel_bench.py" --fast --interpret \
   || fail=1
+# serving-layer end-to-end smoke (fast knobs, ~10 s): concurrent mixed
+# load coalesces bit-identically -> injected slow dispatch produces a
+# phase-named timeout + a retriable shed in the health gauges -> corrupt
+# hot-swap candidate rejected with the old model serving -> valid
+# candidate swaps in bit-identical to a cold load
+echo "=== scripts/serve_smoke.py"
+python -u "$(dirname "$0")/../scripts/serve_smoke.py" || fail=1
+# serve bench smoke (fast knobs, ~15 s on CPU): open-loop mixed-size load
+# through the micro-batching frontend; asserts it completes and reports
+# serve_p50_ms / serve_p99_ms / serve_rows_per_sec / serve_shed_count JSON
+echo "=== bench_serve.py --fast"
+python -u "$(dirname "$0")/../bench_serve.py" --fast || fail=1
 exit $fail

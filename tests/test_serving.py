@@ -1,0 +1,538 @@
+"""Resilient serving layer (lightgbm_tpu/serving.py ServeFrontend).
+
+The serve-side acceptance contract:
+
+- coalesced (micro-batched) responses are BIT-IDENTICAL to unbatched
+  single-request predicts — padding never leaks across requests;
+- a request past its deadline raises ServeTimeoutError NAMING the phase
+  (queue-wait vs dispatch), driven deterministically by the
+  LGBM_TPU_FAULT_SLOW_PREDICT_MS injection point;
+- queue overflow sheds with a retriable ServeOverloadError, increments
+  the health gauges and lands in health_snapshot()'s degradation log;
+- the hot-swap state machine: a failing candidate is rejected with the
+  OLD model still serving bit-identically, in-flight requests complete
+  on the version they were admitted under, and post-swap predictions are
+  bit-identical to a cold-built engine of the new model;
+- the predict engine's caches are thread-safe: concurrent first-touch of
+  one shape bucket compiles exactly once, and a swapped-in model with
+  the same ensemble shape re-uses the old version's compiled programs;
+- a serve-time RESOURCE_EXHAUSTED rides the predict-chunk degradation
+  rung (PR 8) without consuming the training rungs.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import distributed
+from lightgbm_tpu.models import predict_engine as pe
+from lightgbm_tpu.serving import (ServeFrontend, ServeOverloadError,
+                                  ServeSwapError, ServeTimeoutError)
+from lightgbm_tpu.utils import faults, profiling
+
+SLOW_ENV = "LGBM_TPU_FAULT_SLOW_PREDICT_MS"
+OOM_ENV = "LGBM_TPU_FAULT_OOM_AT_PREDICT"
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(11)
+    X = rng.normal(size=(360, 6)).astype(np.float64)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _train(X, y, seed=1, nround=5, **extra):
+    p = {"objective": "binary", "num_leaves": 5, "min_data_in_leaf": 10,
+         "verbosity": -1, "seed": seed}
+    p.update(extra)
+    return lgb.train(p, lgb.Dataset(X, label=y, params=p), nround)
+
+
+@pytest.fixture(scope="module")
+def model(data):
+    X, y = data
+    return _train(X, y)
+
+
+@pytest.fixture()
+def frontend(model):
+    fe = ServeFrontend(model, flush_ms=5.0)
+    yield fe
+    fe.close()
+
+
+# ------------------------------------------------------- batching parity
+def test_single_request_bit_identical(frontend, model, data):
+    X, _ = data
+    assert np.array_equal(frontend.predict(X[:37]), model.predict(X[:37]))
+    assert np.array_equal(frontend.predict(X[:37], raw_score=True),
+                          model.predict(X[:37], raw_score=True))
+
+
+def test_coalesced_bit_identical(model, data):
+    """Concurrent small requests coalesce into fewer dispatches, and every
+    response is bit-identical to the unbatched single-request predict."""
+    X, _ = data
+    fe = ServeFrontend(model, flush_ms=30.0)
+    try:
+        fe.predict(X[:1])                     # warm (compile outside race)
+        before = fe.stats()["batches"]
+        sizes = [1, 3, 17, 40, 8]
+        res = {}
+        errs = {}
+
+        def go(i, a, b):
+            try:
+                res[i] = fe.predict(X[a:b])
+            except BaseException as e:       # noqa: BLE001 — reported
+                errs[i] = e
+
+        offs = np.cumsum([0] + sizes)
+        ts = [threading.Thread(target=go, args=(i, offs[i], offs[i + 1]))
+              for i in range(len(sizes))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        for i in range(len(sizes)):
+            direct = model.predict(X[offs[i]:offs[i + 1]])
+            assert np.array_equal(res[i], direct), f"request {i}"
+        # the 5 requests flushed as fewer engine dispatches (coalesced)
+        assert fe.stats()["batches"] - before < len(sizes)
+    finally:
+        fe.close()
+
+
+def test_padding_never_leaks_across_batch_sizes(frontend, model, data):
+    """A small request served from a serve slot previously filled by a
+    bigger batch must read zero padding, not the stale rows."""
+    X, _ = data
+    big = frontend.predict(X[:100])
+    one = frontend.predict(X[200:201])
+    assert np.array_equal(big, model.predict(X[:100]))
+    assert np.array_equal(one, model.predict(X[200:201]))
+
+
+def test_donated_serve_slots_reused(frontend, model, data):
+    """Steady-state serving keeps one donated buffer slot per shape
+    bucket instead of allocating per call."""
+    X, _ = data
+    for _ in range(3):
+        frontend.predict(X[:50])
+    eng = model._boosting._predict_engine()
+    assert eng.serve_mode
+    assert len(eng._serve_slots) == 1
+    (slot,) = eng._serve_slots.values()
+    assert slot["staging"].shape[0] == eng.bucket_rows(50)
+
+
+# ---------------------------------------------------------- deadlines
+@pytest.mark.faults
+def test_deadline_dispatch_phase(frontend, data):
+    """A slow dispatch (injected) blows the per-request deadline: the
+    caller gets a ServeTimeoutError naming the dispatch phase."""
+    X, _ = data
+    frontend.predict(X[:10])                 # warm: compile is not the test
+    os.environ[SLOW_ENV] = "400"
+    try:
+        with pytest.raises(ServeTimeoutError) as ei:
+            frontend.predict(X[:10], deadline_ms=100.0)
+    finally:
+        del os.environ[SLOW_ENV]
+    assert ei.value.phase == "dispatch"
+    assert "dispatch" in str(ei.value)
+    assert profiling.gauges().get("serve_timeout_count", 0) >= 1
+
+
+@pytest.mark.faults
+def test_deadline_queue_wait_phase(frontend, data):
+    """A request stuck BEHIND a slow dispatch dies in queue-wait — and the
+    error says so (the diagnosable half of the deadline contract)."""
+    X, _ = data
+    frontend.predict(X[:10])                 # warm
+    os.environ[SLOW_ENV] = "500"
+    try:
+        t = threading.Thread(target=lambda: frontend.predict(X[:10]))
+        t.start()
+        time.sleep(0.15)                     # t now inside the slow dispatch
+        with pytest.raises(ServeTimeoutError) as ei:
+            frontend.predict(X[10:20], deadline_ms=80.0)
+        t.join()
+    finally:
+        del os.environ[SLOW_ENV]
+    assert ei.value.phase == "queue-wait"
+    assert "queue-wait" in str(ei.value)
+
+
+# ------------------------------------------------------------- shedding
+@pytest.mark.faults
+def test_queue_overflow_sheds_retriable(model, data):
+    X, _ = data
+    fe = ServeFrontend(model, flush_ms=2.0, max_queue_rows=50)
+    try:
+        fe.predict(X[:10])                   # warm
+        os.environ[SLOW_ENV] = "400"
+        shed_before = distributed.degradations()
+        t1 = threading.Thread(target=lambda: fe.predict(X[:30]))
+        t1.start()
+        time.sleep(0.15)                     # 30 rows in flight
+        t2 = threading.Thread(target=lambda: fe.predict(X[30:45]))
+        t2.start()
+        time.sleep(0.05)                     # +15 rows queued
+        with pytest.raises(ServeOverloadError) as ei:
+            fe.predict(X[45:60])             # +15 would exceed 50
+        t1.join()
+        t2.join()
+    finally:
+        del os.environ[SLOW_ENV]
+        fe.close()
+    assert ei.value.retriable is True
+    assert ei.value.limit == 50
+    assert fe.stats()["shed"] >= 1
+    # gauges + the degradation log both carry the overload
+    assert profiling.gauges().get("serve_shed_count", 0) >= 1
+    sheds = [d for d in distributed.degradations()
+             if d["kind"] == "serve_shed" and d not in shed_before]
+    assert sheds and sheds[-1]["limit"] == 50
+    assert "serve_shed_count" in \
+        distributed.health_snapshot().get("serve", {})
+
+
+def test_shed_episode_count_reaches_degradation_log(model, data):
+    """A shed burst updates ONE recorded episode's count in place — the
+    stored dict, not a copy (record_degradation returns the stored dict
+    precisely so the in-place updates are visible in the log)."""
+    X, _ = data
+    fe = ServeFrontend(model, flush_ms=2.0, max_queue_rows=50)
+    try:
+        with fe._lock:
+            fe._record_shed("default", 10, 50)
+            fe._record_shed("default", 10, 50)
+            fe._record_shed("default", 10, 50)
+        ev = [d for d in distributed.degradations()
+              if d["kind"] == "serve_shed"][-1]
+        assert ev["count"] == 3
+        assert fe.stats()["shed"] == 3
+    finally:
+        fe.close()
+
+
+def test_dispatcher_survives_dispatch_crash(model, data):
+    """An exception escaping _dispatch (e.g. MemoryError concatenating
+    the coalesced batch) must be relayed to the batch's waiters, NOT
+    kill the dispatcher thread — a dead dispatcher strands every later
+    request forever."""
+    X, _ = data
+    fe = ServeFrontend(model, flush_ms=2.0)
+    try:
+        fe.predict(X[:5])                    # healthy warm-up
+        orig = fe._dispatch
+
+        def boom(batch):
+            raise MemoryError("simulated coalesce allocation failure")
+        fe._dispatch = boom
+        with pytest.raises(MemoryError):
+            fe.predict(X[:5])
+        fe._dispatch = orig
+        assert fe._thread.is_alive()
+        assert np.array_equal(fe.predict(X[:5]), model.predict(X[:5]))
+    finally:
+        fe.close()
+
+
+def test_oversized_lone_request_admitted(model, data):
+    """A single request bigger than serve_max_queue_rows on an IDLE
+    frontend must dispatch (alone) instead of being shed with a
+    'retriable' error that could never come true."""
+    X, _ = data
+    fe = ServeFrontend(model, flush_ms=2.0, max_queue_rows=30)
+    try:
+        out = fe.predict(X[:80])
+        assert np.array_equal(out, model.predict(X[:80]))
+        assert fe.stats()["shed"] == 0
+    finally:
+        fe.close()
+
+
+# ------------------------------------------------------------- hot swap
+def test_swap_success_bit_identical_to_cold_engine(model, data):
+    """Post-swap serving is bit-identical to a COLD-built engine of the
+    new model (an identically-trained clone with its own fresh engine)."""
+    X, y = data
+    fe = ServeFrontend(model, flush_ms=2.0)
+    try:
+        fe.predict(X[:20])
+        new = _train(X, y, learning_rate=0.2)
+        cold = _train(X, y, learning_rate=0.2)   # deterministic clone,
+        #                                          own cold engine
+        v = fe.swap("default", new)
+        assert v == 2 and fe.version() == 2
+        out = fe.predict(X[:50])
+        assert np.array_equal(out, cold.predict(X[:50]))
+        # and it genuinely changed the serving model
+        assert not np.array_equal(out, model.predict(X[:50]))
+    finally:
+        fe.close()
+
+
+def test_swap_validation_failure_keeps_old_serving(model, data, tmp_path):
+    """Every rejection shape leaves the registry untouched and the old
+    version serving bit-identically: load failure (corrupt file), wrong
+    feature count, wrong class arity, non-finite probe output."""
+    X, y = data
+    # candidates trained UP FRONT: _init_train resets the process
+    # degradation log, so training between swap attempts would wipe the
+    # rejection events this test counts
+    narrow = _train(X[:, :4], y)                         # feature count
+    multi = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 5,
+         "verbosity": -1},
+        lgb.Dataset(X, label=(X[:, 0] > 0).astype(float)
+                    + (X[:, 1] > 0)), 3)
+    # candidate whose probe output is non-finite: poison a leaf value
+    import re
+    poisoned = re.sub(r"(leaf_value=)([-0-9.e+]+)", r"\1inf",
+                      model.model_to_string(), count=1)
+    fe = ServeFrontend(model, flush_ms=2.0)
+    try:
+        baseline = fe.predict(X[:40])
+        deg_before = len(distributed.degradations())
+
+        bad_file = tmp_path / "corrupt.txt"
+        bad_file.write_text("tree\nversion=v3\nTree=0\ngarbage")
+        with pytest.raises(ServeSwapError):
+            fe.swap("default", str(bad_file))
+
+        with pytest.raises(ServeSwapError, match="failed to predict"):
+            fe.swap("default", narrow)
+
+        with pytest.raises(ServeSwapError, match="arity"):
+            fe.swap("default", multi)
+
+        with pytest.raises(ServeSwapError, match="non-finite"):
+            fe.swap("default", lgb.Booster(model_str=poisoned))
+
+        assert fe.version() == 1
+        assert np.array_equal(fe.predict(X[:40]), baseline)
+        rejects = [d for d in distributed.degradations()[deg_before:]
+                   if d["kind"] == "serve_swap_rejected"]
+        assert len(rejects) == 4
+    finally:
+        fe.close()
+
+
+@pytest.mark.faults
+def test_inflight_requests_complete_on_admitted_version(model, data):
+    """A request admitted under v1 that is still dispatching when the
+    swap lands must return v1's bits (batches hold the entry reference,
+    not the name)."""
+    X, y = data
+    fe = ServeFrontend(model, flush_ms=2.0)
+    try:
+        fe.predict(X[:12])                   # warm v1
+        new = _train(X, y, learning_rate=0.2)    # genuinely different bits
+        _ = new.predict(X[:12])              # warm v2 outside the window
+        os.environ[SLOW_ENV] = "400"
+        res = {}
+        t = threading.Thread(
+            target=lambda: res.update(r1=fe.predict(X[:12])))
+        t.start()
+        time.sleep(0.15)                     # r1 is inside the slow dispatch
+        del os.environ[SLOW_ENV]             # swap validation runs fast
+        fe.swap("default", new)
+        t.join()
+        assert np.array_equal(res["r1"], model.predict(X[:12]))
+        assert np.array_equal(fe.predict(X[:12]), new.predict(X[:12]))
+    finally:
+        os.environ.pop(SLOW_ENV, None)
+        fe.close()
+
+
+def test_swap_same_shape_reuses_compiled_programs(data):
+    """Model versions with the same ensemble shape (tree count, depth,
+    bucket) share the module-level jitted programs: the swap costs ZERO
+    accumulation compiles — the no-recompile-storm-on-reload contract."""
+    X, y = data
+    a = _train(X, y, seed=21, max_depth=2)
+    b = _train(X, y, seed=22, max_depth=2)
+    ea = a._boosting._predict_engine()
+    eb = b._boosting._predict_engine()
+    if (ea.depth, ea.T, ea.k) != (eb.depth, eb.T, eb.k):
+        pytest.skip("ensembles trained to different static shapes")
+    fe = ServeFrontend(a, flush_ms=2.0)
+    try:
+        fe.predict(X[:33])                   # compiles v1's bucket program
+        before = dict(pe.TRACE_COUNTS)
+        fe.swap("default", b)                # probe: same bucket statics
+        fe.predict(X[:33])
+        delta = {k: pe.TRACE_COUNTS[k] - before[k] for k in before}
+        assert delta["accum"] == 0, delta
+    finally:
+        fe.close()
+
+
+# ------------------------------------------------- engine thread safety
+def test_concurrent_first_call_compiles_once(data):
+    """Concurrent FIRST-touch of one shape bucket from many threads must
+    compile its program exactly once (the engine lock serializes the
+    first dispatch of each new program key)."""
+    X, y = data
+    booster = _train(X, y, seed=31, num_leaves=6, nround=7)
+    jax.clear_caches()                       # unique trace, no stale hits
+    pe._compiled_keys.clear()                # sentinel must match the cache
+    barrier = threading.Barrier(4)
+    errs = []
+    outs = [None] * 4
+
+    def go(i):
+        try:
+            barrier.wait(timeout=10)
+            outs[i] = booster.predict(X[:61], raw_score=True)
+        except BaseException as e:           # noqa: BLE001 — reported
+            errs.append(e)
+
+    before = pe.TRACE_COUNTS["accum"]
+    ts = [threading.Thread(target=go, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    assert pe.TRACE_COUNTS["accum"] - before == 1
+    for o in outs[1:]:
+        assert np.array_equal(outs[0], o)
+
+
+# --------------------------------------------------------- serve-time OOM
+@pytest.mark.faults
+def test_serve_oom_rides_predict_chunk_ladder(data):
+    """A RESOURCE_EXHAUSTED inside a serve dispatch shrinks the predict
+    chunk (PR 8 rung), records the degradation, answers the request —
+    and never consumes the TRAINING rungs."""
+    X, y = data
+    booster = _train(X, y, seed=41)
+    fe = ServeFrontend(booster, flush_ms=2.0)
+    try:
+        fe.predict(X[:25])                   # warm
+        deg_before = len(distributed.degradations())
+        faults.reset_predict_oom()
+        os.environ[OOM_ENV] = "1"
+        try:
+            out = fe.predict(X[:25])
+        finally:
+            del os.environ[OOM_ENV]
+        assert np.array_equal(out, booster.predict(X[:25]))
+        ooms = [d for d in distributed.degradations()[deg_before:]
+                if d["kind"] == "oom_predict"]
+        assert len(ooms) == 1
+        g = booster._boosting
+        assert g._oom_level == 0             # training rungs untouched
+        assert g._oom_predict_chunk > 0
+    finally:
+        faults.reset_predict_oom()
+        fe.close()
+
+
+@pytest.mark.faults
+def test_file_loaded_model_oom_rides_ladder(model, data, tmp_path):
+    """A hot-swapped FILE-loaded model (LoadedGBDT host loop, no engine)
+    must honor the same contract: serve-time RESOURCE_EXHAUSTED shrinks
+    its predict chunk, records the degradation, answers the request."""
+    X, _ = data
+    path = tmp_path / "m.txt"
+    model.save_model(str(path))
+    fe = ServeFrontend(model, flush_ms=2.0)
+    try:
+        fe.swap("default", str(path))
+        loaded = lgb.Booster(model_file=str(path))
+        fe.predict(X[:25])                   # warm the swapped entry
+        deg_before = len(distributed.degradations())
+        faults.reset_predict_oom()
+        os.environ[OOM_ENV] = "1"
+        try:
+            out = fe.predict(X[:25])
+        finally:
+            del os.environ[OOM_ENV]
+        assert np.array_equal(out, loaded.predict(X[:25]))
+        ooms = [d for d in distributed.degradations()[deg_before:]
+                if d["kind"] == "oom_predict"]
+        assert len(ooms) == 1
+    finally:
+        faults.reset_predict_oom()
+        fe.close()
+
+
+# ------------------------------------------------------------ lifecycle
+def test_health_gauges_and_stats(frontend, data):
+    X, _ = data
+    for n in (5, 30):
+        frontend.predict(X[:n])
+    serve = distributed.health_snapshot().get("serve", {})
+    for k in ("serve_requests", "serve_batches", "serve_p50_ms",
+              "serve_p99_ms", "serve_queue_rows", "serve_inflight_rows"):
+        assert k in serve, k
+    st = frontend.stats()
+    assert st["requests"] >= 2 and st["p50_ms"] > 0
+    assert st["queued_rows"] == 0 and st["inflight_rows"] == 0
+
+
+def test_close_releases_serve_resources(model, data):
+    """close() must not leave the booster pinning donated per-bucket
+    device buffers or routing later direct predicts through the serve
+    path (no dispatcher exists anymore)."""
+    X, _ = data
+    fe = ServeFrontend(model, flush_ms=2.0)
+    fe.predict(X[:40])
+    eng = model._boosting._predict_engine()
+    assert eng.serve_mode and eng._serve_slots
+    fe.close()
+    assert not eng.serve_mode and not eng._serve_slots
+    assert np.array_equal(model.predict(X[:40]), model.predict(X[:40]))
+
+
+def test_unknown_model_and_closed_frontend(model, data):
+    X, _ = data
+    fe = ServeFrontend(model, flush_ms=2.0)
+    with pytest.raises(KeyError, match="unknown model"):
+        fe.predict(X[:3], model="nope")
+    with pytest.raises(KeyError, match="unknown model"):
+        fe.swap("nope", model)
+    fe.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        fe.predict(X[:3])
+
+
+def test_config_params_steer_policy(data):
+    """serve_* params flow from the registered booster's config when no
+    kwarg overrides are given."""
+    X, y = data
+    b = _train(X, y, serve_flush_ms=7.0, serve_max_batch_rows=123,
+               serve_max_queue_rows=456, serve_deadline_ms=0.0)
+    fe = ServeFrontend(b)
+    try:
+        assert fe.flush_s == pytest.approx(0.007)
+        assert fe.max_batch_rows == 123
+        assert fe.max_queue_rows == 456
+    finally:
+        fe.close()
+
+
+def test_two_models_served_independently(model, data):
+    X, y = data
+    other = _train(X, y, seed=77, nround=3)
+    fe = ServeFrontend(model, flush_ms=2.0)
+    try:
+        fe.register("other", other)
+        assert np.array_equal(fe.predict(X[:20], model="other"),
+                              other.predict(X[:20]))
+        assert np.array_equal(fe.predict(X[:20]), model.predict(X[:20]))
+        assert fe.stats()["models"] == {"default": 1, "other": 1}
+    finally:
+        fe.close()
