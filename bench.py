@@ -73,6 +73,58 @@ def mfu_estimates(sec_per_iter, rows, features, max_bin, num_leaves,
     }
 
 
+def _compile_totals():
+    """Persistent-compile-cache counters (zeros when the hook is off)."""
+    try:
+        from lightgbm_tpu import compile_cache
+        t = compile_cache.totals()
+        return {"hits": t.get("hits", 0), "misses": t.get("misses", 0)}
+    except Exception:
+        return {"hits": 0, "misses": 0}
+
+
+def _warm_child(cfg):
+    """Second-process warm-start measurement (--warm-child, spawned by
+    the warm-start probe): rebuild the SAME-shape dataset and booster
+    against the SAME persistent compile cache the parent just filled,
+    time the first dispatch (data gen/construct excluded — the wall being
+    measured is the XLA compile), and report this process's fused-step
+    cache counters. Zero fused misses == the compile wall is gone."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import compile_cache
+    rng = np.random.RandomState(0)
+    n, f = cfg["rows"], cfg["features"]
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f)
+    logits = X[:, : f // 2] @ w[: f // 2] + 0.5 * np.sin(X[:, f // 2]) * X[:, 0]
+    y = (logits + rng.logistic(size=n) > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": cfg["max_bin"],
+                                         "verbosity": -1})
+    ds.construct()
+    K = cfg["K"]
+    booster = lgb.Booster(params={
+        "objective": "binary", "num_leaves": cfg["num_leaves"],
+        "learning_rate": 0.1, "max_bin": cfg["max_bin"],
+        "min_data_in_leaf": 100, "min_sum_hessian_in_leaf": 100.0,
+        "histogram_method": cfg["hist_method"], "verbosity": -1,
+        "boost_rounds_per_dispatch": K,
+        "compile_cache_dir": cfg["cache_dir"]}, train_set=ds)
+    if K > 1:
+        booster._boosting._block_target = 1 << 30
+    t0 = time.time()
+    booster.update()
+    warm = time.time() - t0
+    print(json.dumps({
+        "warm_start_s": round(warm, 3),
+        "warm_fused_misses": compile_cache.module_count("misses",
+                                                        "jit__fused"),
+        "warm_fused_hits": compile_cache.module_count("hits",
+                                                      "jit__fused"),
+        "warm_cache_hits": _compile_totals()["hits"],
+        "warm_cache_misses": _compile_totals()["misses"]}))
+
+
 def _health_json():
     """Supervision/health telemetry for the result JSON (restart count,
     heartbeat table when supervised, health gauges)."""
@@ -94,6 +146,11 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
     import jax
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils import profiling
+
+    # K iterations per dispatch (the compile-wall PR's scan block):
+    # booster.update() consumes K iterations per call once the block
+    # target is set, so every per-iteration number below divides by K
+    K = max(1, int(getattr(args, "rounds_per_dispatch", 1)))
 
     # TIMETAG scopes force a host sync per phase to attribute wall time —
     # exactly what the async-pipelined steady state must NOT do. Collect
@@ -144,17 +201,26 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
         "histogram_method": hist_method,
         "hist_compaction": hist_compaction,
         "verbosity": -1,
+        "boost_rounds_per_dispatch": K,
+        "compile_cache_dir": getattr(args, "compile_cache_dir", "") or "",
         **(extra_params or {}),
     }, train_set=ds)
+    if K > 1:
+        # opt the manual update loop into K-block consumption (normally
+        # only engine.train sets the target)
+        booster._boosting._block_target = 1 << 30
 
-    # warmup (jit compile + first real iterations)
+    # warmup (jit compile + first real block). With K > 1 the first
+    # update grows K trees — first_iter_compile_s stays the whole wall
+    # (that is the quantity the persistent cache kills), second_iter is
+    # per-iteration steady state
     t0 = time.time()
     booster.update()
     phases["first_iter_incl_compile"] = time.time() - t0
     mark("first_iter_incl_compile")
     t0 = time.time()
     booster.update()
-    phases["second_iter"] = time.time() - t0
+    phases["second_iter"] = (time.time() - t0) / K
     mark("second_iter")
     print(f"# ---- TIMETAG phase table ({hist_method}, warmup iters) ----",
           file=sys.stderr)
@@ -164,6 +230,7 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
 
     # drain outstanding async work so warmup doesn't leak into the timing
     _ = float(booster._boosting.train_score[0].ravel()[0])
+    trees0 = len(booster._boosting.trees)
     disp0 = profiling.dispatch_stats()
     t0 = time.time()
     for _ in range(args.iters):
@@ -174,24 +241,28 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
     # force completion: fetch a scalar that depends on the training state
     # (block_until_ready does not reliably block through the axon tunnel)
     _ = float(booster._boosting.train_score[0].ravel()[0])
-    sec_per_iter = (time.time() - t0) / args.iters
+    sec_per_iter = (time.time() - t0) / (args.iters * K)
     phases["sec_per_iter"] = sec_per_iter
-    disp_per_iter = host_bytes_per_iter = None
+    disp_per_iter = host_bytes_per_iter = trees_per_dispatch = None
     if telemetry:
         d = profiling.dispatch_delta(disp0, disp1)
-        disp_per_iter = d["dispatches"] / args.iters
-        host_bytes_per_iter = (d["d2h_bytes"] + d["h2d_bytes"]) / args.iters
+        disp_per_iter = d["dispatches"] / (args.iters * K)
+        host_bytes_per_iter = (d["d2h_bytes"] + d["h2d_bytes"]) \
+            / (args.iters * K)
+        trees_grown = len(booster._boosting.trees) - trees0
+        trees_per_dispatch = trees_grown / max(d["dispatches"], 1)
         mark(f"dispatch telemetry: {disp_per_iter:.1f} dispatches/iter, "
-             f"{host_bytes_per_iter:.0f} host bytes/iter")
+             f"{host_bytes_per_iter:.0f} host bytes/iter, "
+             f"{trees_per_dispatch:.1f} trees/dispatch")
     mark(f"timed_iters ({sec_per_iter:.3f} s/iter)")
 
     # quality anchor: continue to --rounds total iterations, then held-out
     # AUC (speed without a matched-accuracy number is unfalsifiable)
     auc = None
-    done = 2 + args.iters
+    done = (2 + args.iters) * K
     if args.rounds > done and n_valid > 0:
         t0 = time.time()
-        for _ in range(args.rounds - done):
+        for _ in range(-(-(args.rounds - done) // K)):
             booster.update()
         _ = float(booster._boosting.train_score[0])
         phases["extra_rounds"] = time.time() - t0
@@ -235,7 +306,7 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
          f"(compaction={'on' if hist_compaction else 'off'})")
     return (sec_per_iter, phases, auc, max(args.rounds, done), rows_per_tree,
             disp_per_iter, host_bytes_per_iter, predict_rps,
-            predict_host_bytes)
+            predict_host_bytes, trees_per_dispatch)
 
 
 def sentinel_overhead_probe(rows, args, iters=8, repeats=3):
@@ -305,7 +376,26 @@ def main():
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     ap.add_argument("--no-ladder", action="store_true",
                     help="fail instead of retrying at smaller scales")
+    ap.add_argument("--rounds-per-dispatch", type=int, default=4,
+                    dest="rounds_per_dispatch",
+                    help="boost_rounds_per_dispatch K: iterations grown "
+                         "per compiled dispatch (lax.scan block; 1 = the "
+                         "pre-PR per-iteration dispatch)")
+    ap.add_argument("--compile-cache-dir", default=None,
+                    dest="compile_cache_dir",
+                    help="persistent XLA compile cache dir (default: a "
+                         "fresh temp dir so the warm-start probe can "
+                         "measure the cold/warm delta; '' disables)")
+    ap.add_argument("--no-warm-probe", action="store_true",
+                    help="skip the second-process warm-start probe")
+    ap.add_argument("--warm-child", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
+    if args.warm_child:
+        _warm_child(json.loads(args.warm_child))
+        return
+    if args.compile_cache_dir is None:
+        import tempfile
+        args.compile_cache_dir = tempfile.mkdtemp(prefix="lgb_compile_cache_")
 
     # backend-probe outcome for the result JSON: a CPU number that LOOKS
     # like a TPU number poisons round-over-round comparisons, so the
@@ -368,7 +458,7 @@ def main():
                 print(f"# trying rows={rows} hist={hm}", file=sys.stderr)
                 (sec_per_iter, phases, auc, rounds_run, rows_per_tree,
                  disp_per_iter, host_bytes_per_iter, predict_rps,
-                 predict_host_bytes) = \
+                 predict_host_bytes, trees_per_dispatch) = \
                     run_at_scale(rows, args, hist_method=hm)
                 used_rows = rows
                 used_method = hm
@@ -448,6 +538,17 @@ def main():
         "compact_sec_per_iter": round(sec_per_iter, 4),
         "rows_streamed_per_tree": round(rows_per_tree, 1)
         if rows_per_tree is not None else None,
+        # the compile wall (ISSUE 10): the first dispatch's full wall
+        # (XLA compile + first block), the K-block shape, and this
+        # process's persistent-cache counters; the warm_start_s probe
+        # below supplies the second-process (cache-hit) side of the delta
+        "first_iter_compile_s": round(
+            phases.get("first_iter_incl_compile", 0.0), 3),
+        "trees_per_dispatch": round(trees_per_dispatch, 2)
+        if trees_per_dispatch is not None else None,
+        "boost_rounds_per_dispatch": args.rounds_per_dispatch,
+        "compile_cache_hits": _compile_totals()["hits"],
+        "compile_cache_misses": _compile_totals()["misses"],
         "phases": {k: round(v, 3) for k, v in phases.items()},
         # training-supervision health (distributed.health_snapshot +
         # profiling gauges): supervisor restart count, last completed
@@ -477,7 +578,7 @@ def main():
     nc_sec = nc_rows = None
     if probe_headroom("nocompact"):
         try:
-            nc_sec, _, _, _, nc_rows, _, _, _, _ = run_at_scale(
+            nc_sec, _, _, _, nc_rows, _, _, _, _, _ = run_at_scale(
                 used_rows, args, hist_method=used_method,
                 hist_compaction=False)
             print(f"# nocompact probe: {nc_sec:.3f} s/iter, "
@@ -516,6 +617,50 @@ def main():
     })
     print(json.dumps(result), flush=True)
 
+    # warm-start probe (the compile wall's other half): a SECOND process
+    # at the same shape against the persistent cache this run just
+    # filled — its first dispatch should be a cache deserialization
+    # (zero fused-step XLA compiles), and first_iter_compile_s vs
+    # warm_start_s is the cold/warm delta on record
+    warm = None
+    if (not args.no_warm_probe and args.compile_cache_dir
+            and probe_headroom("warm-start")):
+        import subprocess
+        env = dict(os.environ)
+        env["_LGB_TPU_BENCH_PROBED"] = "1"
+        if args.cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+        cfg = {"rows": used_rows, "features": args.features,
+               "max_bin": args.max_bin, "num_leaves": args.num_leaves,
+               "hist_method": used_method, "K": args.rounds_per_dispatch,
+               "cache_dir": args.compile_cache_dir}
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--warm-child", json.dumps(cfg)],
+                capture_output=True, text=True, env=env, timeout=1200)
+            lines = [l for l in r.stdout.splitlines()
+                     if l.startswith("{")]
+            if r.returncode == 0 and lines:
+                warm = json.loads(lines[-1])
+                print(f"# warm-start probe: cold "
+                      f"{result['first_iter_compile_s']}s -> warm "
+                      f"{warm['warm_start_s']}s, fused misses "
+                      f"{warm['warm_fused_misses']}", file=sys.stderr)
+            else:
+                tail = (r.stderr or "").strip().splitlines()[-3:]
+                print(f"# warm-start probe failed: {' | '.join(tail)}",
+                      file=sys.stderr)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            print("# warm-start probe failed; omitting", file=sys.stderr)
+    result.update({
+        "warm_start_s": warm["warm_start_s"] if warm else None,
+        "warm_fused_misses": warm["warm_fused_misses"] if warm else None,
+        "warm_cache_hits": warm["warm_cache_hits"] if warm else None,
+    })
+    print(json.dumps(result), flush=True)
+
     # secondary probes: the quantized-gradient mode and the max_bin=63
     # configuration. These run on EVERY backend (they were TPU-gated
     # before, which left the q8_*/bin63_* fields permanently null on CPU
@@ -544,7 +689,7 @@ def main():
     q8_sec = q8_auc = q8_mfu = q8_ref_auc = None
     if probe_headroom("q8"):
         try:
-            q8_sec, q8_ph, q8_auc, _, _, _, _, _, _ = run_at_scale(
+            q8_sec, q8_ph, q8_auc, _, _, _, _, _, _, _ = run_at_scale(
                 probe_rows, probe_args, hist_method="auto",
                 extra_params={"quantized_grad": True})
             q8_mfu = mfu_estimates(
@@ -559,7 +704,7 @@ def main():
             elif probe_headroom("q8-f32-ref"):
                 # reduced-scale probe (CPU fallback): the q8 AUC needs an
                 # f32 reference at the SAME scale to be a quality delta
-                _, _, q8_ref_auc, _, _, _, _, _, _ = run_at_scale(
+                _, _, q8_ref_auc, _, _, _, _, _, _, _ = run_at_scale(
                     probe_rows, probe_args, hist_method=used_method)
                 print(f"# q8 f32 reference auc={q8_ref_auc}",
                       file=sys.stderr)
@@ -577,7 +722,7 @@ def main():
     if args.max_bin != 63 and probe_headroom("bin63"):
         b63_args = argparse.Namespace(**{**vars(probe_args), "max_bin": 63})
         try:
-            b63_sec, b63_ph, b63_auc, _, _, _, _, _, _ = run_at_scale(
+            b63_sec, b63_ph, b63_auc, _, _, _, _, _, _, _ = run_at_scale(
                 probe_rows, b63_args, hist_method="auto")
             print(f"# max_bin=63: {b63_sec:.3f} s/iter, "
                   f"auc={b63_auc}", file=sys.stderr)
@@ -590,7 +735,7 @@ def main():
         # the projected fastest configuration, with its own AUC readout
         if probe_headroom("bin63+q8"):
             try:
-                b63q8_sec, _, b63q8_auc, _, _, _, _, _, _ = run_at_scale(
+                b63q8_sec, _, b63q8_auc, _, _, _, _, _, _, _ = run_at_scale(
                     probe_rows, b63_args, hist_method="auto",
                     extra_params={"quantized_grad": True})
                 print(f"# max_bin=63 + q8: {b63q8_sec:.3f} s/iter, "

@@ -108,6 +108,10 @@ def reset_parameter(**kwargs) -> Callable:
             env.model.reset_parameter(new_parameters)
     _callback.before_iteration = True
     _callback.order = 10
+    # per-iteration schedules and boost_rounds_per_dispatch K-blocks are
+    # incompatible (a block dispatch bakes ONE value for K iterations):
+    # engine.train reads this flag and falls back to K=1 for the run
+    _callback.is_reset_parameter = True
     return _callback
 
 
@@ -247,4 +251,8 @@ def checkpoint(directory: str, period: int = 1, keep: int = 2) -> Callable:
             distributed.check_model_integrity(boosting, env.iteration)
         state["mgr"].save(model, env.iteration + 1)
     _callback.order = 40
+    # engine.train validates this against boost_rounds_per_dispatch: a
+    # period that is not a multiple of K can never fire at a block
+    # boundary and is rejected up front
+    _callback.ckpt_period = period
     return _callback

@@ -460,6 +460,34 @@ class Config:
     # false forces the phase-by-phase path — a debugging escape hatch and
     # the reference side of the fused-vs-unfused bit-parity test suite.
     fused_iteration: bool = True
+    # grow this many boosting iterations per compiled-program dispatch: a
+    # lax.scan over iterations INSIDE the fused program (the scan body is
+    # the fused step re-keyed by the scanned iteration index), emitting K
+    # stacked iterations' trees per dispatch and carrying the score cache
+    # in-program — bit-identical to K separate fused iterations (the
+    # carry add uses the pre-shrunk-tree gather form so nothing can
+    # FMA-contract). Amortizes both the per-iteration dispatch round trip
+    # and — the big one — the first-iteration XLA compile wall across K
+    # trees. Only engine.train drives block consumption (manual
+    # Booster.update loops keep one-iteration semantics); evaluation,
+    # callbacks and early stopping run at block boundaries, and a
+    # checkpoint callback period must be a multiple of K (rejected
+    # otherwise). Configurations the fused gate excludes fall back to 1.
+    boost_rounds_per_dispatch: int = 1
+    # persistent XLA compilation cache directory ("" = disabled unless
+    # JAX_COMPILATION_CACHE_DIR is already set): compiled programs are
+    # keyed by (HLO, backend, flags) and written to disk, so a restarted
+    # supervisor incarnation, a resumed elastic gang, or a second
+    # same-shape process pays each compile ONCE EVER instead of once per
+    # process — the 232s first-iteration wall at 10.5M rows becomes a
+    # cache deserialization on every later start
+    compile_cache_dir: str = ""
+    # AOT-warm the training programs (fused step + score add) at
+    # checkpoint-restore time via jit(...).lower().compile(): with the
+    # persistent cache above, a warm restart reaches its first iteration
+    # with zero XLA recompiles; without it, the compile simply moves from
+    # the first boosting step to restore time
+    compile_warmup: bool = True
 
     # Inference engine (models/predict_engine.py; no reference analog)
     # row-padding floor of the predict compile cache: batch rows pad up to

@@ -169,6 +169,17 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                     cb.set_state(cb_states[key])
             log.info(f"resumed from checkpoint {ckpt.path} at iteration "
                      f"{start_iter}")
+            from . import compile_cache
+            if getattr(booster.config, "compile_warmup", True) \
+                    and compile_cache.configure(booster.config):
+                # AOT-warm the training programs NOW, before the loop:
+                # with the persistent compilation cache a restarted
+                # incarnation deserializes the fused step from disk here
+                # and reaches its first iteration with zero XLA compiles.
+                # ONLY with a cache configured — jax's AOT compile does
+                # not feed the jit call cache, so a cacheless warmup
+                # would be a pure duplicate compile
+                booster._boosting.warm_start()
 
     from . import distributed
     from .utils import faults
@@ -187,24 +198,74 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     integ_period = int(getattr(booster.config, "integrity_check_period", 0)
                        or 0)
     integ_on = integ_period > 0 and jax.process_count() > 1
+    boosting = booster._boosting
+    # --- K-iterations-per-dispatch handshake (boost_rounds_per_dispatch):
+    # only THIS loop may let one update() consume a whole K-block (it
+    # advances its round counter by the consumed count below); a manual
+    # Booster.update loop or cv() never opts in and keeps per-iteration
+    # semantics. Callbacks/eval run at block boundaries, so:
+    #   - a checkpoint callback period must be a multiple of K (a
+    #     mid-block checkpoint cannot exist — the block is one atomic
+    #     dispatch — so misaligned periods are REJECTED, loudly);
+    #   - per-iteration parameter schedules (reset_parameter /
+    #     learning_rates) disable blocking for the run — their values
+    #     must apply per iteration, not per block.
+    k_block = max(1, int(getattr(booster.config,
+                                 "boost_rounds_per_dispatch", 1)))
+    if k_block > 1 and hasattr(boosting, "_block_rounds"):
+        # the schedule fallback is decided FIRST: with blocking disabled
+        # the run is per-iteration, where any checkpoint period is valid
+        # — rejecting it would refuse a run that executes fine
+        if any(getattr(cb, "is_reset_parameter", False)
+               for cb in cbs_before):
+            log.info(f"boost_rounds_per_dispatch={k_block} disabled for "
+                     f"this run: a reset_parameter/learning_rates "
+                     f"callback applies per-iteration values the block "
+                     f"dispatch cannot honor")
+            boosting._block_disable = True
+        else:
+            for cb in (cbs_before + cbs_after):
+                p = getattr(cb, "ckpt_period", None)
+                if p and p > 0 and p % k_block != 0:
+                    log.fatal(
+                        f"checkpoint period {p} is not a multiple of "
+                        f"boost_rounds_per_dispatch={k_block}: a "
+                        f"K-iteration block is one atomic dispatch, so a "
+                        f"mid-block checkpoint cannot be captured. Use a "
+                        f"period that is a multiple of {k_block}, or set "
+                        f"boost_rounds_per_dispatch=1.")
+        boosting._block_target = num_boost_round
     try:
-        for i in range(start_iter, num_boost_round):
+        i = start_iter
+        while i < num_boost_round:
             faults.maybe_kill(fault_plan, i)
             faults.maybe_hang(fault_plan, i)
             for cb in cbs_before:
                 cb(CallbackEnv(model=booster, params=params, iteration=i,
                                begin_iteration=0, end_iteration=num_boost_round,
                                evaluation_result_list=None))
+            it_before = boosting.iter
             booster.update(fobj=fobj)
-            if integ_on and (i + 1) % integ_period == 0:
-                distributed.check_model_integrity(booster._boosting, i)
+            # a K-block consumes several iterations in one update() —
+            # advance by what actually happened (1 everywhere else)
+            consumed = max(1, boosting.iter - it_before)
+            i += consumed
+            # fire whenever a period boundary was CROSSED in the consumed
+            # span, not only when i lands exactly on one — today blocks
+            # cannot engage multi-process (fused requires one process),
+            # but this keeps the divergence-check frequency exact if that
+            # ever changes
+            if integ_on and (i // integ_period) > \
+                    ((i - consumed) // integ_period):
+                distributed.check_model_integrity(boosting, i - 1)
 
             evaluation_result_list = []
-            if valid_sets or booster._boosting.config.is_provide_training_metric:
+            if valid_sets or boosting.config.is_provide_training_metric:
                 evaluation_result_list = booster.eval_set(feval)
             try:
                 for cb in cbs_after:
-                    cb(CallbackEnv(model=booster, params=params, iteration=i,
+                    cb(CallbackEnv(model=booster, params=params,
+                                   iteration=i - 1,
                                    begin_iteration=0, end_iteration=num_boost_round,
                                    evaluation_result_list=evaluation_result_list))
             except EarlyStopException as es:
@@ -215,8 +276,9 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         # judge every still-deferred numerics sentinel (the fused path's
         # flag words are fetched lazily; without this flush a NaN born in
         # the final rounds could go unreported)
-        booster._boosting._flush_sentinel()
+        boosting._flush_sentinel()
     finally:
+        boosting._block_target = None
         health.stop()
     return booster
 

@@ -125,6 +125,27 @@ def _bagging_subset(key: jax.Array, bins: jax.Array, k: int):
     return mask, sub_idx, sub_bins, sub_bins.T
 
 
+def _fma_guard(x: jax.Array, salt_u32: jax.Array) -> jax.Array:
+    """Value-preserving rounding fence: bitcast ``x`` to uint32, XOR with
+    a RUNTIME-ZERO salt the compiler cannot prove zero, bitcast back.
+
+    Why it exists: inside one compiled program XLA's CPU/TPU backends
+    contract a multiply feeding an add into an FMA whose single rounding
+    drifts 1 ulp from the two-rounding sequence — and they do it even
+    across ``optimization_barrier`` and through a gather whose operand is
+    the multiply (both verified here; the PR 3 lesson that forced the
+    score add into its own program). The K-block scan cannot split the
+    program (the score is its carry), so this fence breaks the FLOAT
+    dataflow instead: the multiply's result must round to a concrete f32
+    bit pattern to enter the integer domain, and no fmul-fadd pattern
+    survives for the backend to contract. The salt (e.g. ``it0 < -1`` on
+    a non-negative operand) is what stops the algebraic simplifier from
+    cancelling the bitcast pair and re-exposing the multiply."""
+    xi = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    xi = jnp.bitwise_xor(xi, salt_u32)
+    return jax.lax.bitcast_convert_type(xi, jnp.float32)
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _apply_score_delta(score: jax.Array, delta: jax.Array) -> jax.Array:
     """Score-cache update for the fused iteration, as its OWN tiny program
@@ -346,6 +367,10 @@ class GBDT:
         # degradation log: this booster's health snapshots / checkpoint
         # manifests must not inherit an earlier booster's OOM events
         distributed.reset_degradations()
+        # persistent XLA compile cache (compile_cache_dir): pay each
+        # program compile once per shape EVER, not once per process
+        from .. import compile_cache
+        compile_cache.configure(cfg)
         # pre-partitioned mode (distributed.load_partitioned): bins are a
         # global row-sharded array; labels/weights/scores/gradients stay
         # PROCESS-LOCAL (the reference's per-machine score partition,
@@ -806,9 +831,13 @@ class GBDT:
         (packed NaN/Inf bits for gradients, hessians, the histogram
         plane, leaf outputs and the score delta) that the host checks
         from the iteration's own results — the guard works WITH the fused
-        path instead of gating it off (PR 3's limitation, lifted)."""
+        path instead of gating it off (PR 3's limitation, lifted).
+        Subclasses whose only deviation is an in-program-expressible
+        sampling scheme (GOSS) opt in via ``_fused_sampling``; DART and
+        RF stay host-interleaved."""
         cfg = self.config
-        return (type(self) is GBDT
+        return ((type(self) is GBDT
+                 or getattr(self, "_fused_sampling", False))
                 and cfg.fused_iteration
                 and grad_external is None
                 # NaN-gradient injection needs the gradients materialized
@@ -953,16 +982,17 @@ class GBDT:
         self._fused_bind_cache[use_binsT] = (pg, self._forced_splits, pb)
         return pb
 
-    def _fused_step_fn(self, hm: str, fmask_on: bool):
-        """One jitted program per boosting iteration: objective gradients
-        -> bagging draw -> per-class tree growth -> shrinkage -> score
-        deltas, fused so the host dispatches the whole grow phase ONCE
-        (three-plus dispatches otherwise, and per-class multiples for
-        multiclass — each a transport round trip through a TPU tunnel)
-        and XLA fuses the elementwise gradient math into the grower's
-        first histogram pass instead of materializing grad/hess through
-        HBM. The reference's TrainOneIter phases (gbdt.cpp:369-452)
-        collapse into one program:
+    def _fused_step_fn(self, hm: str, fmask_on: bool, k_rounds: int = 1):
+        """One jitted program per boosting iteration — or per K-iteration
+        BLOCK (``k_rounds`` > 1, the ``boost_rounds_per_dispatch`` scan):
+        objective gradients -> sampling draw -> per-class tree growth ->
+        shrinkage -> score deltas, fused so the host dispatches the whole
+        grow phase ONCE (three-plus dispatches otherwise, and per-class
+        multiples for multiclass — each a transport round trip through a
+        TPU tunnel) and XLA fuses the elementwise gradient math into the
+        grower's first histogram pass instead of materializing grad/hess
+        through HBM. The reference's TrainOneIter phases
+        (gbdt.cpp:369-452) collapse into one program:
 
         - multiclass grows all ``num_tree_per_iteration`` class trees via
           a ``lax.scan`` over the class axis — the grower (and its
@@ -973,25 +1003,54 @@ class GBDT:
           the fused program, so distributed iterations also collapse to
           one dispatch;
         - bagging (mask or subset copy) is drawn in-program from the
-          period-start key — bit-identical to the host refresh draw and
-          never interleaved as a separate dispatch;
+          period-start key, and GOSS's one-side sampling weights from the
+          per-iteration key — bit-identical to the host refresh draws
+          and never interleaved as separate dispatches;
         - CEGB's cross-iteration aux rides through as device-resident
           loop state (operand in, operand out).
 
-        The score update itself is the SECOND (and last) dispatch of the
-        iteration — ``_apply_score_delta``, a donated in-place add kept
-        out of this program so the backend cannot FMA-contract it against
-        the leaf-value shrinkage (see its docstring; bit-parity). Trees
-        are returned SHRUNK (Tree::Shrinkage applied in-program — the
-        same elementwise multiply finalize would apply). Cached by the
-        STATIC grow options (+ objective/constant identities), so
+        EVERY dataset-constant array — the bin matrices, the objective's
+        label/weight (and derived label_sign/onehot/... tables), feature
+        metadata, bundle/forced-split/interaction/CEGB tables — enters
+        the program as an OPERAND through the cached ``bind`` dict, never
+        as a closure constant: closure constants are embedded in the HLO
+        and their label-derived subexpressions become dataset-sized
+        constant folds at COMPILE time (BENCH_r04 measured >6 s alarms on
+        single instructions at 10.5M rows). The hoist test pins the
+        traced jaxpr's constant footprint near zero.
+
+        Per-iteration mode (``k_rounds`` == 1): the score update is the
+        SECOND (and last) dispatch — ``_apply_score_delta``, a donated
+        in-place add kept out of this program so the backend cannot
+        FMA-contract it against the leaf-value shrinkage (see its
+        docstring; bit-parity).
+
+        Block mode (``k_rounds`` K > 1): a ``lax.scan`` over the K
+        iterations carries the score cache IN-PROGRAM (the donated score
+        operand is the carry seed), with each step re-keyed by the
+        scanned absolute iteration index — the same fold_in(…, it)
+        streams the per-iteration mode draws, so the block is
+        bit-identical to K separate fused iterations. The carry update
+        keeps the exact two-rounding sequence of the split programs:
+        trees are shrunk FIRST (round(leaf_value*lr), the [L]-sized
+        multiply), the per-row delta is a GATHER of the pre-shrunk leaf
+        values, and the gathered delta passes the ``_fma_guard``
+        rounding fence before the add — the backend contracts a multiply
+        feeding an add even across ``optimization_barrier`` AND through
+        the gather (both re-verified; the PR 3 lesson), so only the
+        fence's integer round-trip actually pins the rounding. One
+        dispatch grows K*C trees.
+
+        Trees are returned SHRUNK either way. Cached by the STATIC grow
+        options (+ objective/constant identities + k_rounds), so
         dynamic-parameter resets (learning_rates schedules) never
         retrace. Returns ``(step, bind)`` where ``bind`` holds the
-        dataset-constant operands the caller passes each iteration."""
+        dataset-constant operands the caller passes each call."""
         ts = self.train_set
         obj = self.objective
         cfg = self.config
         k = self.num_tree_per_iteration
+        kk = max(1, int(k_rounds))
         pg = self._parallel_grower
         bag_mode = self._bagging_mode()
         sub_k = self._subset_rows() if bag_mode == "subset" else 0
@@ -1007,9 +1066,18 @@ class GBDT:
         from ..utils import faults as faults_mod
         sentinels = bool(cfg.check_numerics)
         nan_hist_it = faults_mod.nan_hist_iter(self._fault_plan)
-        key = (id(obj), k, bag_mode, sub_k, frac_kind, fmask_on,
+        n = self._n_score_rows
+        # GOSS one-side sampling as in-program statics (goss.hpp:105-150):
+        # the subclass opts in via _fused_sampling; counts and the
+        # 1/learning_rate warm-up gate are static per (n, rates, lr)
+        goss_on = bool(getattr(self, "_fused_sampling", False))
+        goss_top = max(1, int(n * cfg.top_rate)) if goss_on else 0
+        goss_other = max(1, int(n * cfg.other_rate)) if goss_on else 0
+        goss_warm = int(1.0 / cfg.learning_rate) if goss_on else 0
+        key = (id(obj), k, kk, bag_mode, sub_k, frac_kind, fmask_on,
                pg.mode if pg is not None else "serial",
                sentinels, nan_hist_it,
+               goss_on, goss_top, goss_other, goss_warm,
                cfg.bagging_freq, cfg.bagging_seed, cfg.extra_seed,
                # the by-node fraction is closed over below (a constant of
                # the program): key it so a reset_parameter change
@@ -1022,25 +1090,27 @@ class GBDT:
         if hit is not None:
             return hit
         from .tree import leaf_values_of_rows
-        n = self._n_score_rows
         f_used = ts.num_used_features()
         freq = cfg.bagging_freq
         extra_key = self._extra_rng_key
         bag_key0 = jax.random.PRNGKey(cfg.bagging_seed)
         has_sp = getattr(ts, "has_sparse_cols", False)
         cegb_on = self._cegb_mode != "off"
-        ig = self._interaction_groups
-        cegb_coupled = self._cegb_coupled
-        cegb_lazy = self._cegb_lazy
-        forced = self._forced_splits
         bynode_frac = (jnp.float32(cfg.feature_fraction_bynode)
                        if self._use_bynode else None)
+        # dataset-constant OPERANDS (see docstring): one cached dict the
+        # caller passes per dispatch — the host-side cost is a pointer
+        # walk, the compile-time win is that nothing here can be folded
         if pg is not None:
             pb = self._fused_parallel_bindings(hm)
             shard = pg.get_shard_fn(pb["extras_spec"],
                                     tuple(sorted(grow_kw.items())))
             bind = dict(bins=pb["bins"], binsT=None, sp_rows=None,
-                        sp_bins=None, sp_default=None, extras=pb["extras"])
+                        sp_bins=None, sp_default=None, extras=pb["extras"],
+                        meta=pb["meta"], missing_bin=pb["missing_bin"],
+                        bundle_meta=None, forced=None, igroups=None,
+                        cegb_coupled=None, cegb_lazy=None,
+                        obj_consts=obj.device_consts())
         else:
             pb = shard = None
             bind = dict(bins=ts.bins,
@@ -1048,12 +1118,22 @@ class GBDT:
                         sp_rows=ts.sp_rows if has_sp else None,
                         sp_bins=ts.sp_bins if has_sp else None,
                         sp_default=ts.sp_default if has_sp else None,
-                        extras=None)
+                        extras=None,
+                        meta=ts.feature_meta, missing_bin=ts.missing_bin,
+                        bundle_meta=ts.bundle_meta,
+                        forced=self._forced_splits,
+                        igroups=self._interaction_groups,
+                        cegb_coupled=self._cegb_coupled,
+                        cegb_lazy=self._cegb_lazy,
+                        obj_consts=obj.device_consts())
 
-        def step(score, bins, binsT, fmask, sparams, it, lr, bag_frac,
-                 cegb_state, sp_rows, sp_bins, sp_default, extras,
-                 rows_acc, coll_acc):
-            g, h = obj.get_grad_hess(score)
+        def one_iter(score, it, lr, fmask_it, cegb_state, rows_acc,
+                     coll_acc, sparams, bag_frac, b):
+            """One boosting iteration's traced body — shared verbatim by
+            the per-iteration program and the K-block scan (re-keyed by
+            the traced absolute iteration index ``it``)."""
+            with obj.bound(b["obj_consts"]):
+                g, h = obj.get_grad_hess(score)
             if nan_hist_it >= 0:
                 # traced NaN injection (LGBM_TPU_FAULT_NAN_HIST_AT_ITER):
                 # poison one gradient value INSIDE the program at the
@@ -1073,43 +1153,72 @@ class GBDT:
                 else:
                     r = jax.random.bits(bkey, (n,), jnp.uint32)
                     sub_idx = jnp.argsort(r)[:sub_k].astype(jnp.int32)
-                    sub_bins = jnp.take(bins, sub_idx, axis=0)
+                    sub_bins = jnp.take(b["bins"], sub_idx, axis=0)
                     sub = (sub_idx, sub_bins, sub_bins.T)
+            if goss_on:
+                # GOSS weights from the per-iteration key, exactly the
+                # host path's _sample_weights -> goss_weights sequence;
+                # the warm-up arm (< 1/learning_rate iterations) skips
+                # the draw like the host's early return
+                from .goss import goss_weights_impl
+
+                def _sampled(args):
+                    g0, h0 = args
+                    sc = jnp.sum(jnp.abs(g0 * h0), axis=1) if k > 1 \
+                        else jnp.abs(g0 * h0)
+                    w = goss_weights_impl(
+                        sc, jax.random.fold_in(bag_key0, it),
+                        goss_top, goss_other)
+                    wk = w[:, None] if k > 1 else w
+                    return g0 * wk, h0 * wk, (w > 0).astype(jnp.float32)
+
+                def _warm(args):
+                    g0, h0 = args
+                    return g0, h0, mask
+
+                g, h, mask = jax.lax.cond(it >= goss_warm, _sampled,
+                                          _warm, (g, h))
 
             def grow_c(gc, hc, fmask_c, key_c, cegb_aux):
                 if pg is None:
                     tree, leaf_id, aux = grow_tree(
-                        bins, gc, hc, mask, ts.feature_meta, sparams,
-                        fmask_c, ts.missing_bin, binsT=binsT,
-                        rng_key=key_c, bundle_meta=ts.bundle_meta,
-                        forced_splits=forced,
+                        b["bins"], gc, hc, mask, b["meta"], sparams,
+                        fmask_c, b["missing_bin"], binsT=b["binsT"],
+                        rng_key=key_c, bundle_meta=b["bundle_meta"],
+                        forced_splits=b["forced"],
                         sub_idx=sub[0] if sub else None,
                         sub_bins=sub[1] if sub else None,
                         sub_binsT=sub[2] if sub else None,
-                        interaction_groups=ig,
-                        cegb_coupled=cegb_coupled,
-                        cegb_lazy_penalty=cegb_lazy,
+                        interaction_groups=b["igroups"],
+                        cegb_coupled=b["cegb_coupled"],
+                        cegb_lazy_penalty=b["cegb_lazy"],
                         cegb_state=cegb_aux,
                         bynode_fraction=bynode_frac,
-                        sp_rows=sp_rows, sp_bins=sp_bins,
-                        sp_default=sp_default, **grow_kw)
+                        sp_rows=b["sp_rows"], sp_bins=b["sp_bins"],
+                        sp_default=b["sp_default"], **grow_kw)
                 else:
                     gp = jnp.pad(gc, (0, pb["n_pad"]))
                     hp = jnp.pad(hc, (0, pb["n_pad"]))
                     mp = jnp.pad(mask, (0, pb["n_pad"]))
                     fp = jnp.pad(fmask_c, (0, pb["f_pad"]))
                     tree, leaf_id, aux = shard(
-                        bins, gp, hp, mp, pb["meta"], sparams, fp,
-                        pb["missing_bin"], extras, key_c)
+                        b["bins"], gp, hp, mp, b["meta"], sparams, fp,
+                        b["missing_bin"], b["extras"], key_c)
                     leaf_id = leaf_id[:n]
-                delta = leaf_values_of_rows(tree.leaf_value, leaf_id) * lr
-                return _shrink_tree(tree, lr), delta, aux
+                # shrink FIRST, then GATHER the pre-shrunk leaf values:
+                # identical bits to gather-then-multiply (gather commutes
+                # with the elementwise mul), but the block mode's in-carry
+                # score add then sees no multiply to FMA-contract
+                tree = _shrink_tree(tree, lr)
+                delta = leaf_values_of_rows(tree.leaf_value, leaf_id)
+                return tree, delta, aux
 
-            fm = fmask if fmask_on else jnp.ones((k, f_used), jnp.float32)
+            fm = fmask_it if fmask_on else jnp.ones((k, f_used),
+                                                    jnp.float32)
             if k == 1:
                 key0 = jax.random.fold_in(extra_key, it * k)
                 tree, delta, aux = grow_c(g, h, fm[0], key0, cegb_state)
-                trees = (tree,)
+                trees_st = tree
                 rows, coll = aux.rows_streamed, aux.coll_bytes
                 hist_sent = aux.sentinel
                 cegb_out = aux if cegb_on else None
@@ -1130,8 +1239,6 @@ class GBDT:
                 carry0 = cegb_state if cegb_on else jnp.int32(0)
                 carry, (trees_st, delta, rows_st, coll_st, sent_st) = \
                     jax.lax.scan(body, carry0, (g.T, h.T, fm, keys))
-                trees = tuple(jax.tree.map(lambda x: x[c], trees_st)
-                              for c in range(k))
                 rows, coll = jnp.sum(rows_st), jnp.sum(coll_st)
                 hist_sent = jnp.sum(sent_st)
                 cegb_out = carry if cegb_on else None
@@ -1142,19 +1249,81 @@ class GBDT:
                 # fetched by the host with this iteration's results — no
                 # extra dispatch, no host round trip of the arrays
                 bad = lambda x: jnp.any(~jnp.isfinite(x))  # noqa: E731
-                leaf_bad = functools.reduce(
-                    jnp.logical_or, [bad(t.leaf_value) for t in trees])
-                u32 = lambda b: b.astype(jnp.uint32)       # noqa: E731
+                leaf_bad = bad(trees_st.leaf_value)
+                u32 = lambda bv: bv.astype(jnp.uint32)     # noqa: E731
                 flags = (u32(bad(g)) | (u32(bad(h)) << 1)
                          | (u32(hist_sent > 0) << 2)
                          | (u32(leaf_bad) << 3)
                          | (u32(bad(delta)) << 4))
             else:
                 flags = jnp.uint32(0)
-            return (trees, delta, rows_acc + rows, coll_acc + coll,
+            return (trees_st, delta, rows_acc + rows, coll_acc + coll,
                     cegb_out, flags)
 
-        step = jax.jit(step)
+        def _unstack_classes(trees_st):
+            if k == 1:
+                return (trees_st,)
+            return tuple(jax.tree.map(lambda x: x[c], trees_st)
+                         for c in range(k))
+
+        if kk == 1:
+            def _fused_step(score, it, lr, fmask, sparams, bag_frac,
+                            cegb_state, rows_acc, coll_acc, b):
+                trees_st, delta, rows, coll, cegb_out, flags = one_iter(
+                    score, it, lr, fmask, cegb_state, rows_acc, coll_acc,
+                    sparams, bag_frac, b)
+                return (_unstack_classes(trees_st), delta, rows, coll,
+                        cegb_out, flags)
+
+            step = jax.jit(_fused_step)
+        else:
+            def _fused_block(score, it0, lr, fmask, sparams, bag_frac,
+                             cegb_state, rows_acc, coll_acc, b):
+                """K boosting iterations per dispatch: scan the fused
+                step over the absolute iteration indices, score cache in
+                the carry (donated operand in, aliased result out). See
+                the outer docstring and _fma_guard for the FMA-safety
+                argument."""
+                cegb0 = cegb_state if cegb_on else jnp.int32(0)
+                # runtime-zero XOR salt (it0 is never negative): the
+                # compiler cannot fold it, so the _fma_guard fence around
+                # the carry add survives every optimization pass
+                salt = (it0 < jnp.int32(-1)).astype(jnp.uint32)
+
+                def body(carry, xs):
+                    score_c, cegb_c, rows_c, coll_c = carry
+                    if fmask_on:
+                        j, fm_it = xs
+                    else:
+                        j, fm_it = xs, None
+                    trees_st, delta, rows_c, coll_c, cegb_out, flags = \
+                        one_iter(score_c, it0 + j, lr, fm_it,
+                                 cegb_c if cegb_on else cegb_state,
+                                 rows_c, coll_c, sparams, bag_frac, b)
+                    # the in-carry analog of _apply_score_delta: delta is
+                    # a gather of PRE-SHRUNK leaf values, passed through
+                    # the _fma_guard rounding fence — the backend cannot
+                    # contract the shrinkage multiply into this add, so
+                    # the two-rounding sequence (and bit-parity with the
+                    # split per-iteration programs) is preserved
+                    d = delta.T if delta.ndim == 2 else delta
+                    score_c = score_c + _fma_guard(d, salt)
+                    return ((score_c, cegb_out if cegb_on else cegb_c,
+                             rows_c, coll_c), (trees_st, flags))
+
+                js = jnp.arange(kk, dtype=jnp.int32)
+                xs = (js, fmask) if fmask_on else js
+                (score_f, cegb_f, rows_f, coll_f), (trees_all, flags) = \
+                    jax.lax.scan(body, (score, cegb0, rows_acc, coll_acc),
+                                 xs)
+                trees = tuple(
+                    _unstack_classes(jax.tree.map(lambda x: x[j],
+                                                  trees_all))
+                    for j in range(kk))
+                return (trees, score_f, rows_f, coll_f,
+                        cegb_f if cegb_on else None, flags)
+
+            step = jax.jit(_fused_block, donate_argnums=(0,))
         if len(self._fused_cache) >= 8:
             # oldest-entry eviction: each parallel bind can pin a padded
             # O(N*F) dataset copy — a reset_parameter sweep over statics
@@ -1309,6 +1478,40 @@ class GBDT:
         self._flush_pending(only_ready=True)
         return no_split or self._lagged_stop
 
+    def _block_rounds(self) -> int:
+        """How many iterations the NEXT fused dispatch should grow — the
+        ``boost_rounds_per_dispatch`` K, clipped so blocks (a) never run
+        past the engine's round target and (b) always END on a multiple
+        of K (the first block after an unaligned resume truncates to
+        re-align), which is what lets a checkpoint callback whose period
+        is a multiple of K fire on schedule. 1 unless engine.train has
+        opted in for this run (``_block_target``): a manual
+        ``Booster.update`` loop or cv() must keep one-iteration-per-call
+        semantics, or its round counting would double-train."""
+        cfg = self.config
+        K = max(1, int(cfg.boost_rounds_per_dispatch))
+        if K <= 1:
+            return 1
+        target = getattr(self, "_block_target", None)
+        if target is None or getattr(self, "_block_disable", False):
+            return 1
+        remaining = int(target) - self.iter
+        aligned = K - (self.iter % K)
+        return max(1, min(aligned, remaining))
+
+    def _fused_call_args(self, fmask, bind, it=None):
+        """The fused step/block argument tuple — ONE definition shared by
+        the training dispatch and the AOT warmup (warm_start), so the
+        warmed program signature can never drift from the called one."""
+        bag_mode = self._bagging_mode()
+        bag_frac = self._bagging_frac() if bag_mode == "mask" else None
+        cegb_state = self._fused_cegb_state()
+        return (self.train_score,
+                np.int32(self.iter if it is None else it),
+                np.float32(self.shrinkage_rate), fmask, self.split_params,
+                bag_frac, cegb_state, self._rows_streamed_dev,
+                self._coll_bytes_dev, bind)
+
     def _train_one_iter_fused(self) -> bool:
         """Fused iteration for every admitted configuration (see
         _fused_step_fn): TWO compiled-program dispatches — the fused grow
@@ -1316,16 +1519,21 @@ class GBDT:
         per-class multiples) on the unfused path; everything after
         mirrors the unfused finalize/add/bias flow per class. The step
         returns SHRUNK trees, so on the steady-state lazy path nothing
-        else dispatches — the telemetry tests assert it stays that way."""
+        else dispatches — the telemetry tests assert it stays that way.
+
+        With ``boost_rounds_per_dispatch`` K > 1 under engine.train, the
+        whole K-iteration BLOCK runs instead (_train_block_fused): ONE
+        dispatch grows K*C trees with the score carried in-program."""
+        K = self._block_rounds()
+        if K > 1:
+            return self._train_block_fused(K)
         from ..utils import profiling
         hm = self._hist_method()
         fmask = self._feature_mask_np()
         step, bind = self._fused_step_fn(hm, fmask is not None)
         bag_mode = self._bagging_mode()
-        bag_frac = self._bagging_frac() if bag_mode == "mask" else None
         if bag_mode != "off":
             self._bag_stale = True   # host mask not refreshed this iter
-        cegb_state = self._fused_cegb_state()
         prev = None
         if profiling.enabled():
             prev = (float(self._rows_streamed_dev),
@@ -1333,12 +1541,7 @@ class GBDT:
         with profiling.timer_sync("grow_tree") as grow_scope:
             (trees, delta, self._rows_streamed_dev,
              self._coll_bytes_dev, cegb_aux, sent_flags) = step(
-                self.train_score, bind["bins"], bind["binsT"], fmask,
-                self.split_params, np.int32(self.iter),
-                np.float32(self.shrinkage_rate), bag_frac, cegb_state,
-                bind["sp_rows"], bind["sp_bins"], bind["sp_default"],
-                bind["extras"], self._rows_streamed_dev,
-                self._coll_bytes_dev)
+                *self._fused_call_args(fmask, bind))
             grow_scope.sync(trees[0].num_leaves)
         if self.config.check_numerics:
             # the flag word is judged LAZILY (_drain_sentinels below): a
@@ -1383,6 +1586,132 @@ class GBDT:
         self._flush_pending(only_ready=True)
         self._drain_sentinels()
         return (not lazy and no_split) or self._lagged_stop
+
+    def _train_block_fused(self, K: int) -> bool:
+        """K boosting iterations in ONE compiled-program dispatch (the
+        ``boost_rounds_per_dispatch`` block, _fused_step_fn's scan mode):
+        the score cache is donated in and carried through the scan, K*C
+        shrunk trees come back stacked, and the host-side finalize/add/
+        bias flow then runs per iteration in order — so valid-set scores,
+        the bias fold and the lagged-stop bookkeeping are identical to K
+        separate fused iterations. Everything external (callbacks, eval,
+        checkpoints) happens at block boundaries only; engine.train
+        validates the checkpoint period against K and advances its round
+        counter by the consumed count."""
+        from ..utils import profiling
+        hm = self._hist_method()
+        fmask_on = self.config.feature_fraction < 1.0
+        fmask = None
+        if fmask_on:
+            # the SAME stateful host rng stream, drawn K iterations ahead
+            # in the per-iteration order (bit-parity with K single steps)
+            fmask = np.stack([self._feature_mask_np() for _ in range(K)])
+        step, bind = self._fused_step_fn(hm, fmask_on, k_rounds=K)
+        if self._bagging_mode() != "off":
+            self._bag_stale = True   # host mask not refreshed this block
+        it0 = self.iter
+        prev = None
+        if profiling.enabled():
+            prev = (float(self._rows_streamed_dev),
+                    float(self._coll_bytes_dev))
+        with profiling.timer_sync("grow_tree") as grow_scope:
+            (trees, self.train_score, self._rows_streamed_dev,
+             self._coll_bytes_dev, cegb_aux, sent_flags) = step(
+                *self._fused_call_args(fmask, bind))
+            grow_scope.sync(trees[0][0].num_leaves)
+        if self.config.check_numerics:
+            # one [K] flag vector per block, judged lazily like the
+            # per-iteration scalars (_drain_sentinels names it0 + j)
+            self._sentinel_pending.append((it0, sent_flags))
+        if cegb_aux is not None:
+            self._cegb_aux = cegb_aux
+        if prev is not None:
+            profiling.counter("hist_rows_streamed",
+                              float(self._rows_streamed_dev) - prev[0])
+            profiling.counter("hist_coll_bytes",
+                              float(self._coll_bytes_dev) - prev[1])
+        lazy = self._lazy_host_ok(sentinels=True)
+        stop = False
+        for j in range(K):
+            no_split = True
+            for c, tree in enumerate(trees[j]):
+                with profiling.timer("finalize_tree"):
+                    if lazy:
+                        t_host, had_split = None, True
+                    else:
+                        t_host = jax.device_get(tree)
+                        had_split = int(t_host.num_leaves) > 1
+                no_split = no_split and not had_split
+                with profiling.timer("score_update", sync=None):
+                    self._add_tree(tree, None, c, t_host=t_host, lazy=lazy,
+                                   score_updated=True)
+                    self._bias_after_score(c, had_split)
+            self.iter += 1
+            # a splitless iteration anywhere in the block arms the stop;
+            # any later trees of the same block are splitless zero trees,
+            # prediction-identical to stopping on time (the same argument
+            # as the lazy path's lagged stop)
+            stop = stop or (not lazy and no_split)
+        self._flush_pending(only_ready=True)
+        self._drain_sentinels()
+        return stop or self._lagged_stop
+
+    # --------------------------------------------------- AOT compile warm
+    def warm_start(self, k_rounds: Optional[int] = None) -> bool:
+        """AOT-compile the training programs for the current
+        configuration — ``jax.jit(...).lower(...).compile()`` on the
+        fused step/block (which embeds the grower) and the donated score
+        add, with argument shapes taken from the live trainer state so
+        the warmed signatures exactly match the first real dispatch.
+
+        With the persistent compilation cache configured
+        (``compile_cache_dir``), this is how a restarted supervisor
+        incarnation, a resumed elastic gang or a second same-shape
+        process starts HOT: the XLA compile the first boosting step would
+        pay becomes a disk-cache deserialization here, before the
+        training loop begins. Without the cache it still moves the
+        compile wall out of the measured first iteration. Returns True
+        when a program was AOT-compiled; False (with the reason logged at
+        info) when the configuration is not fused-eligible."""
+        from .. import compile_cache
+        if self.train_set is None or not self._fused_ok(None):
+            return False
+        try:
+            K = k_rounds if k_rounds is not None else self._block_rounds()
+            # outside engine.train (_block_target unset) warm the
+            # configured block size directly: the warmed program must be
+            # the one the training loop will dispatch
+            if k_rounds is None and K == 1:
+                cfgK = max(1, int(self.config.boost_rounds_per_dispatch))
+                if cfgK > 1:
+                    K = cfgK - (self.iter % cfgK)
+            hm = self._hist_method()
+            fmask_on = self.config.feature_fraction < 1.0
+            step, bind = self._fused_step_fn(hm, fmask_on,
+                                             k_rounds=K)
+            k = self.num_tree_per_iteration
+            f = self.train_set.num_used_features()
+            fmask = None
+            if fmask_on:
+                shape = (K, k, f) if K > 1 else (k, f)
+                fmask = jax.ShapeDtypeStruct(shape, jnp.float32)
+            args = self._fused_call_args(fmask, bind)
+            ok = compile_cache.aot_compile(step, args, label="fused_step")
+            if ok and K == 1:
+                # the per-iteration mode's second dispatch: the donated
+                # in-place score add (block mode carries it in-program)
+                d_shape = ((k, self._n_score_rows) if k > 1
+                           else (self._n_score_rows,))
+                compile_cache.aot_compile(
+                    _apply_score_delta,
+                    (jax.ShapeDtypeStruct(self._score_shape, jnp.float32),
+                     jax.ShapeDtypeStruct(d_shape, jnp.float32)),
+                    label="score_delta")
+            return ok
+        except Exception as e:   # warmup must never break training
+            log.warning(f"AOT compile warmup failed (training will "
+                        f"compile lazily instead): {e}")
+            return False
 
     def _grow_one(self, gc: jax.Array, hc: jax.Array, mask: jax.Array,
                   fmask: jax.Array, iter_key: jax.Array, hm: str):
@@ -1676,7 +2005,7 @@ class GBDT:
                 except AttributeError:
                     pass
             q.pop(0)
-            self._check_sentinel_flags(int(flags), it)
+            self._judge_sentinel(it, flags)
 
     def _flush_sentinel(self) -> None:
         """Blocking judge of EVERY deferred in-program sentinel word
@@ -1688,7 +2017,19 @@ class GBDT:
         q = self._sentinel_pending
         while q:
             it, flags = q.pop(0)
-            self._check_sentinel_flags(int(flags), it)
+            self._judge_sentinel(it, flags)
+
+    def _judge_sentinel(self, it: int, flags) -> None:
+        """Judge one pending sentinel entry: a scalar word (per-iteration
+        fused step) or a [K] vector (one word per iteration of a
+        ``boost_rounds_per_dispatch`` block, oldest first so the FIRST
+        poisoned iteration is the one named)."""
+        arr = np.atleast_1d(np.asarray(flags))
+        if arr.size == 1:
+            self._check_sentinel_flags(int(arr[0]), it)
+            return
+        for j in range(arr.size):
+            self._check_sentinel_flags(int(arr[j]), it + j)
 
     # ------------------------------------------------ OOM degradation
     def _eff_hist_block(self, blk: int) -> int:
@@ -1725,6 +2066,25 @@ class GBDT:
         from ..utils import faults, profiling
         if not self.config.hist_oom_fallback \
                 or not faults.is_resource_exhausted(exc):
+            return False
+        try:
+            score_gone = bool(self.train_score.is_deleted())
+        except Exception:
+            score_gone = False
+        if score_gone:
+            # the K-block step DONATES the score cache; an OOM during
+            # EXECUTION (not compile — the common case — which fails
+            # before any donation) may have consumed the buffer, so the
+            # iteration cannot be retried in-process. Fail stop with the
+            # real remedy named instead of crashing the retry on a
+            # deleted array.
+            log.warning(
+                f"RESOURCE_EXHAUSTED in boosting iteration {self.iter}: "
+                f"the failed K-block dispatch consumed the donated score "
+                f"cache, so the degradation ladder cannot retry "
+                f"in-process — resume from the last checkpoint (or set "
+                f"boost_rounds_per_dispatch=1) with a smaller "
+                f"hist_block/scatter fallback")
             return False
         if jax.process_count() > 1:
             # gangs FAIL-STOP on a training OOM instead of degrading: the

@@ -41,22 +41,12 @@ def _stable_ranks(x: jax.Array) -> jax.Array:
     return jnp.argsort(jnp.argsort(x))
 
 
-@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
-def goss_weights(score: jax.Array, key: jax.Array, top_k: int,
-                 other_k: int) -> jax.Array:
-    """Per-row GOSS weights entirely on device (goss.hpp:105-150, without
-    the reference's host-side argsort — at 10M rows the score download +
-    single-core sort + weight upload serialized every iteration).
-
-    Exact counts: exactly ``top_k`` rows keep weight 1 (threshold = k-th
-    largest score; score ties broken by random 31-bit draws, draw
-    collisions broken by row index via a stable rank — thresholding the
-    draws directly would admit every colliding row, overshooting the
-    targets by the collision count at 10M-row scale) and exactly
-    ``min(other_k, n - top_k)`` of the rest keep the amplification weight
-    (n - top_k)/other_k — the device analog of sampling without
-    replacement.
-    """
+def goss_weights_impl(score: jax.Array, key: jax.Array, top_k: int,
+                      other_k: int) -> jax.Array:
+    """Traced body of :func:`goss_weights` — the single definition the
+    standalone jitted wrapper (unfused path) and the fused step's
+    in-program sampling (gbdt._fused_step_fn, ``_fused_sampling``) share,
+    so the two paths cannot drift and their draws stay bit-identical."""
     n = score.shape[0]
     svals = jnp.sort(score)
     t = svals[n - top_k]                       # k-th largest value
@@ -79,10 +69,34 @@ def goss_weights(score: jax.Array, key: jax.Array, top_k: int,
             + pick.astype(jnp.float32) * multiply)
 
 
+@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
+def goss_weights(score: jax.Array, key: jax.Array, top_k: int,
+                 other_k: int) -> jax.Array:
+    """Per-row GOSS weights entirely on device (goss.hpp:105-150, without
+    the reference's host-side argsort — at 10M rows the score download +
+    single-core sort + weight upload serialized every iteration).
+
+    Exact counts: exactly ``top_k`` rows keep weight 1 (threshold = k-th
+    largest score; score ties broken by random 31-bit draws, draw
+    collisions broken by row index via a stable rank — thresholding the
+    draws directly would admit every colliding row, overshooting the
+    targets by the collision count at 10M-row scale) and exactly
+    ``min(other_k, n - top_k)`` of the rest keep the amplification weight
+    (n - top_k)/other_k — the device analog of sampling without
+    replacement.
+    """
+    return goss_weights_impl(score, key, top_k, other_k)
+
+
 class GOSS(GBDT):
     """reference: goss.hpp:25 `class GOSS: public GBDT`."""
 
     name = "goss"
+    # the fused one-dispatch step (and the boost_rounds_per_dispatch
+    # K-block) admits GOSS: its sampling is pure device math keyed on the
+    # iteration index, expressed in-program via goss_weights_impl — see
+    # gbdt._fused_ok / _fused_step_fn
+    _fused_sampling = True
 
     def __init__(self, config: Config, train_set: Optional[Dataset] = None,
                  objective: Optional[ObjectiveFunction] = None):

@@ -481,6 +481,59 @@ class PredictEngine:
             self._programs[key] = True
             return dispatch()
 
+    def warm_aot(self, rows: int, n_features: int, bins_dtype,
+                 missing_bin, serve: bool = False) -> bool:
+        """AOT-compile the full-ensemble accumulation program for the
+        row BUCKET ``rows`` pads to — the same shape-bucket key the
+        predict compile cache builds on first touch, compiled via
+        ``jit(...).lower(...).compile()`` without touching device data.
+
+        ``serve``: warm the SERVE variant instead — ``_serve_accum_jit``
+        with a concrete donated carry operand, the program the
+        steady-state ``_serve_chunk`` loop actually dispatches (the plain
+        variant builds its carry in-program from ``carry=None``; the two
+        are different HLO modules, so warming one does not warm the
+        other). ``ServeFrontend.register`` warms the
+        ``serve_max_batch_rows`` bucket through this before traffic.
+
+        With the persistent compilation cache configured
+        (``compile_cache_dir``), a fresh process warms its buckets from
+        DISK here instead of paying the XLA compile on the first
+        full-size batch. Sharded engines skip (their shard_map wrappers
+        are built per mesh at dispatch)."""
+        if self.sharded:
+            return False
+        from .. import compile_cache
+        with _x64_scope(self.accum):
+            stacked, class_of, biases = self._range_operands(0, self.T,
+                                                             True)
+            statics = dict(depth=self.depth, k=self.k,
+                           use_bias=biases is not None, use_active=False,
+                           accum=self.accum, init_zero=True)
+            bucket = self.bucket_rows(int(rows))
+            bins_sds = jax.ShapeDtypeStruct((bucket, int(n_features)),
+                                            np.dtype(bins_dtype))
+            if serve:
+                shape = (bucket,) if self.k == 1 else (bucket, self.k)
+                if self.accum == "compensated":
+                    s = jax.ShapeDtypeStruct(shape, jnp.float32)
+                    carry_sds = (s, s)
+                else:
+                    dt = jnp.float64 if self.accum == "float64" \
+                        else jnp.float32
+                    carry_sds = jax.ShapeDtypeStruct(shape, dt)
+                return compile_cache.aot_compile(
+                    _serve_accum_jit(),
+                    (stacked, class_of, biases, bins_sds, missing_bin,
+                     carry_sds, None),
+                    label="predict_engine serve accum",
+                    static_kwargs=statics)
+            return compile_cache.aot_compile(
+                _accum_jit,
+                (stacked, class_of, biases, bins_sds, missing_bin,
+                 None, None),
+                label="predict_engine accum", static_kwargs=statics)
+
     def fetch(self, carry, n: int) -> np.ndarray:
         """Slice off the row padding and fetch the result — the ONLY
         device->host transfer of a predict: ``n * K * itemsize`` bytes."""

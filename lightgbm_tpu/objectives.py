@@ -93,6 +93,43 @@ class ObjectiveFunction:
     def get_grad_hess(self, score: jax.Array):
         raise NotImplementedError
 
+    # ------------------------------------------------- traced-program use
+    def device_consts(self) -> dict:
+        """Every device-resident array this objective closes over in
+        ``get_grad_hess`` (label, weight, and subclass derivatives such as
+        the binary label_sign/label_weight or the multiclass onehot).
+
+        A jitted training step that calls ``get_grad_hess`` directly
+        embeds these O(N) arrays as CONSTANTS of the compiled program —
+        and every label-derived subexpression (``label_sign * sigmoid``,
+        the softmax onehot subtraction setup, ...) becomes dataset-
+        constant compute XLA constant-folds AT COMPILE TIME, taking
+        multi-second alarms per instruction at 10M-row scale
+        (BENCH_r04). The fused step instead fetches this dict once,
+        passes it as program OPERANDS, and traces ``get_grad_hess``
+        under :meth:`bound` so the arrays enter the program as
+        parameters that cannot be folded."""
+        return {k: v for k, v in vars(self).items()
+                if isinstance(v, jax.Array)}
+
+    def bound(self, consts: dict):
+        """Context manager substituting ``device_consts``-shaped values
+        (typically tracers, inside a jit trace) for the objective's
+        device arrays, restoring the originals on exit."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _ctx():
+            old = {k: getattr(self, k) for k in consts}
+            try:
+                for k, v in consts.items():
+                    setattr(self, k, v)
+                yield self
+            finally:
+                for k, v in old.items():
+                    setattr(self, k, v)
+        return _ctx()
+
     def boost_from_score(self, class_id: int = 0) -> float:
         return 0.0
 

@@ -355,6 +355,30 @@ class ServeFrontend:
                 f"value(s) — refusing to serve NaN/Inf")
         return arity
 
+    def _warm_serve_bucket(self, booster) -> None:
+        """Best-effort AOT warmup of the ``serve_max_batch_rows`` row
+        bucket on the model's inference engine (trained boosters only —
+        file-loaded models predict through the host tree walk and have
+        no engine to warm). Never fails registration."""
+        try:
+            boosting = getattr(booster, "_boosting", None)
+            ts = getattr(boosting, "train_set", None)
+            if boosting is None or ts is None \
+                    or not hasattr(boosting, "_predict_engine"):
+                return
+            eng = boosting._predict_engine()
+            if eng is None:
+                return
+            # the predict path bins new data via bin_data: int32, one
+            # column per USED feature (basic.py bin_new_data). serve=True
+            # warms the donated-carry serve program — the one the
+            # steady-state flush loop dispatches, not the plain
+            # build-carry-in-program variant
+            eng.warm_aot(self.max_batch_rows, ts.num_used_features(),
+                         np.int32, ts.missing_bin, serve=True)
+        except Exception as e:
+            log.warning(f"serve bucket AOT warmup skipped: {e}")
+
     def register(self, name: str, model, *,
                  probe: Optional[np.ndarray] = None) -> int:
         """Register (or replace, validated) a named model. ``probe``: the
@@ -372,6 +396,18 @@ class ServeFrontend:
                 probe = np.zeros((4, nf), np.float64)
         probe = _as_request_matrix(probe)
         arity = self._validate(booster, probe)
+        # compile wall, serve side: point this process at the persistent
+        # compilation cache and AOT-warm the engine's serve-size bucket
+        # BEFORE traffic arrives — the probe predict above only compiled
+        # the probe's (small) bucket; without this the first full
+        # coalesced batch pays the big bucket's XLA compile (a disk read
+        # when a previous process already compiled the shape). Warmup
+        # only runs WITH a cache configured: jax's AOT compile does not
+        # feed the jit call cache, so a cacheless warmup would just
+        # compile the bucket twice
+        from . import compile_cache
+        if compile_cache.configure(booster.config):
+            self._warm_serve_bucket(booster)
         if existing is not None and arity != existing.arity:
             # register() is the UNGUARDED replace path (swap() enforces
             # same-arity): changing the serving contract is allowed here

@@ -440,6 +440,13 @@ def train_supervised(params: dict, data, label=None,
     ``checkpoint_period`` iterations and resuming BIT-IDENTICALLY across
     gang restarts.
 
+    Relaunch cost: pass ``compile_cache_dir`` in ``params`` (a shared
+    persistent XLA compile cache path) and every relaunched incarnation
+    starts HOT — the resume path AOT-warms the training programs
+    (``GBDT.warm_start``) against the disk cache, so a gang restart pays
+    zero fused-step XLA recompiles instead of the full first-iteration
+    compile wall (see README "Compile wall").
+
     Replication is what makes the restart exact: with every rank's trainer
     state identical (SPMD over replicated rows), rank 0's checkpoint
     restores the whole gang. Pre-partitioned datasets keep process-LOCAL

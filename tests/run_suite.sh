@@ -24,6 +24,14 @@ python -u "$(dirname "$0")/../scripts/supervisor_smoke.py" || fail=1
 echo "=== scripts/kernel_bench.py"
 python -u "$(dirname "$0")/../scripts/kernel_bench.py" --fast --interpret \
   || fail=1
+# compile-wall smoke (~20 s, CPU backend): cold process trains K=4
+# blocks-per-dispatch against a fresh persistent compile cache +
+# checkpoint; a SECOND process resumes from the checkpoint against the
+# same cache and must perform ZERO fused-step XLA compiles (disk hits
+# only) while continuing bit-identically to an uninterrupted run — the
+# supervisor-relaunch warm path at its smallest shape
+echo "=== scripts/compile_wall_smoke.py"
+python -u "$(dirname "$0")/../scripts/compile_wall_smoke.py" || fail=1
 # serving-layer end-to-end smoke (fast knobs, ~10 s): concurrent mixed
 # load coalesces bit-identically -> injected slow dispatch produces a
 # phase-named timeout + a retriable shed in the health gauges -> corrupt
