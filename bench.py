@@ -309,6 +309,52 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
             predict_host_bytes, trees_per_dispatch)
 
 
+def phase_scope_probe(rows, args, hist_method="auto", iters=3):
+    """Per-phase grow_tree breakdown: train a bounded-scale booster on the
+    PHASE-BY-PHASE path (fused_iteration=false) with TIMETAG on, which
+    routes growth through the host-phased grower (grow_tree_phased) —
+    each round is its own dispatch, so ``hist_pass`` / ``split_search`` /
+    ``apply_split`` wall time is attributable per phase on every backend
+    (the epilogue's win shows as split_search collapsing). Returns the
+    sub-scope dict for the BENCH JSON ``phases`` entry plus the
+    dispatch-count frontier check (hist_pass launches per tree — one per
+    frontier LEVEL, not per leaf)."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import profiling
+    rng = np.random.RandomState(1)
+    n, f = min(rows, 200_000), args.features
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f)
+    y = (X @ w + rng.logistic(size=n) > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": args.max_bin,
+                                         "verbosity": -1})
+    booster = lgb.Booster(params={
+        "objective": "binary", "num_leaves": args.num_leaves,
+        "learning_rate": 0.1, "max_bin": args.max_bin,
+        "min_data_in_leaf": 100, "min_sum_hessian_in_leaf": 100.0,
+        "histogram_method": hist_method, "fused_iteration": False,
+        "verbosity": -1}, train_set=ds)
+    was = profiling.enabled()
+    profiling.reset()
+    profiling.enable(True)
+    try:
+        booster.update()          # compile-laden first iteration
+        profiling.reset()         # keep only warm per-phase times
+        for _ in range(iters):
+            booster.update()
+        sc = profiling.scopes()
+    finally:
+        profiling.enable(was)
+        profiling.reset()
+    out = {}
+    for name in ("hist_pass", "split_search", "apply_split"):
+        if name in sc:
+            out[name] = round(sc[name]["total_s"] / iters, 4)
+            out[f"{name}_calls"] = round(sc[name]["calls"] / iters, 1)
+    return out
+
+
 def sentinel_overhead_probe(rows, args, iters=8, repeats=3):
     """Cost of the in-program numerics sentinels on the fused iteration
     (check_numerics with fused_iteration — the training-integrity layer's
@@ -374,6 +420,12 @@ def main():
                     help="hard deadline (s) on the TPU backend-init probe "
                          "subprocess before falling back to CPU")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    ap.add_argument("--require-tpu", action="store_true", dest="require_tpu",
+                    help="fail LOUDLY (exit 2, error JSON with "
+                         "tpu_required=true) instead of falling back to "
+                         "CPU — a requested-TPU round must never publish "
+                         "CPU numbers under a TPU-looking filename "
+                         "(BENCH_r04/r05 did exactly that)")
     ap.add_argument("--no-ladder", action="store_true",
                     help="fail instead of retrying at smaller scales")
     ap.add_argument("--rounds-per-dispatch", type=int, default=4,
@@ -436,12 +488,28 @@ def main():
             args.rounds = min(args.rounds, 20)
             args.valid_rows = min(args.valid_rows, 50_000)
         os.environ["_LGB_TPU_BENCH_PROBED"] = "1"
+
+    def tpu_required_bail(why):
+        # --require-tpu: fail loudly with a parseable error record — a
+        # requested-TPU round must never publish CPU numbers
+        print(json.dumps({"metric": "higgs_sec_per_iter", "value": None,
+                          "unit": "s/iter", "vs_baseline": None,
+                          "tpu_required": True, "backend": "cpu",
+                          "probe_error": probe_error,
+                          "error": f"TPU required but unavailable: {why}"}),
+              flush=True)
+        sys.exit(2)
+
+    if args.require_tpu and args.cpu:
+        tpu_required_bail(probe_error or "--cpu forced")
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
     import jax
     dev = jax.devices()[0]
     print(f"# device: {dev}", file=sys.stderr)
+    if args.require_tpu and jax.default_backend() != "tpu":
+        tpu_required_bail(f"backend is {jax.default_backend()!r}")
 
     ladder = list(dict.fromkeys(
         r for r in (args.rows, 2_000_000, 500_000) if r <= args.rows))
@@ -512,9 +580,11 @@ def main():
         "auc_rounds": rounds_run,
         "hist_method": used_method,
         # backend-probe outcome (satellite: the fallback reason must be in
-        # the JSON, not only a stderr comment)
+        # the JSON, not only a stderr comment); tpu_required records
+        # whether this round was allowed to fall back at all
         "backend": jax.default_backend(),
         "probe_error": probe_error,
+        "tpu_required": bool(args.require_tpu),
         # dispatch/host-sync telemetry over the timed loop (see
         # utils/profiling.py install_dispatch_hook): compiled-program
         # launches and explicit host<->device transfer bytes per
@@ -570,6 +640,22 @@ def main():
                   f"({args.probe_deadline}s)", file=sys.stderr)
             return False
         return True
+
+    # per-phase grow_tree sub-scopes (the phased grower's hist_pass /
+    # split_search / apply_split TIMETAG scopes at a bounded scale): the
+    # fused split epilogue's win is measurable per phase on every backend
+    # — split_search collapses to bookkeeping and hist_pass_calls counts
+    # ONE launch per frontier level, not per leaf
+    if probe_headroom("phase-scopes"):
+        try:
+            ph = phase_scope_probe(used_rows, args, hist_method=used_method)
+            result["phases"].update(ph)
+            print(f"# grow_tree phase sub-scopes (per iter): {ph}",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            print("# phase-scope probe failed; omitting", file=sys.stderr)
+    print(json.dumps(result), flush=True)
 
     # compaction on/off headroom probe (runs on ANY backend — the row
     # reduction shows on the CPU scatter path too): same scale with

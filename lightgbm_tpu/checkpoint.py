@@ -78,6 +78,12 @@ _NON_TRAINING_PARAMS = frozenset({
     # histogram_method itself, which is hashed. quantized_grad is NOT
     # here — it changes the trained model.
     "hist_autotune",
+    # split_fusion is bit-identical to the classic split phase by
+    # contract (tests/test_split_fusion.py pins model-text parity), so
+    # toggling it between incarnations is execution strategy, not model
+    # drift; the kernel-shape ride it DOES affect is handled by the
+    # epilogue-keyed autotune cache (gbdt._hist_tuning)
+    "split_fusion",
     "heartbeat_interval", "collective_deadline", "max_restarts",
     "rank_restart_budget", "min_world_size",
     # training-integrity knobs: the divergence-check cadence and the OOM

@@ -437,6 +437,25 @@ class Config:
     # winner is wall-clock-dependent and the method/block are static SPMD
     # program parameters that must match across shards)
     hist_autotune: bool = True
+    # fused split-finding epilogue + level-batched frontier growth
+    # (ops/pallas_hist.py epilogue kernels, models/grower.py
+    # tile_pass_fused): the split-gain scan + per-feature argmax run in
+    # the histogram pass itself — in kernel on the Pallas methods — and
+    # sibling pairs share one frontier launch with the larger child's
+    # plane derived in-pass (parent - smaller), so the split phase
+    # consumes a tiny [L, F] candidate table instead of re-reading the
+    # [L, F, B, 3] planes. "auto" (default) enables it whenever the
+    # numerical non-bundled search is the whole story — serial learner,
+    # no categorical features, no EFB bundles, no forced splits, no CEGB,
+    # no extra_trees/bynode sampling, basic-or-off monotone constraints,
+    # f32 histograms — and falls back to the classic split phase
+    # otherwise (those semantics stay in ops/split.py find_best_splits).
+    # "on" asserts instead of falling back; "off" forces the classic
+    # phase (the reference side of the fusion bit-parity suite). Model
+    # text is bit-identical to the classic path on representable sums
+    # (tier-1-asserted), structure-identical within documented f32
+    # bounds otherwise.
+    split_fusion: str = "auto"
     # run the Pallas histogram kernels through the Pallas INTERPRETER on
     # non-TPU backends (tests/CI): the production TPU pipeline — fused
     # leaf channels, in-kernel row gather, q8 — becomes CPU-testable;
@@ -573,6 +592,9 @@ class Config:
             log.fatal("feature_fraction should be in (0.0, 1.0]")
         if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
             log.fatal("num_class must be >= 2 for multiclass objectives")
+        if self.split_fusion not in ("auto", "on", "off"):
+            log.fatal(f"split_fusion must be auto/on/off, "
+                      f"got {self.split_fusion!r}")
         log.set_verbosity(self.verbosity)
 
     def to_params(self) -> Dict[str, Any]:

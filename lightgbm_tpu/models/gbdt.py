@@ -864,7 +864,8 @@ class GBDT:
         ts = self.train_set
         has_sp = getattr(ts, "has_sparse_cols", False)
         fb = self._feature_block(hm)
-        tile, blk = self._hist_tuning(hm)
+        sf = self._split_fusion_on(hm, fb)
+        tile, blk = self._hist_tuning(hm, epilogue=sf)
         return dict(
             max_leaves=cfg.num_leaves, num_bins=ts.max_num_bins,
             max_depth=cfg.max_depth, hist_method=hm,
@@ -872,6 +873,7 @@ class GBDT:
             hist_interpret=self._hist_interpret(),
             numerics_sentinels=cfg.check_numerics,
             feature_block=fb,
+            split_fusion=sf,
             exact=cfg.tree_growth_mode == "exact",
             with_categorical=ts.has_categorical,
             with_monotone=self._with_monotone,
@@ -1743,7 +1745,18 @@ class GBDT:
                 **self._parallel_grow_statics(hm))
         sub = self._bag_sub
         has_sp = getattr(ts, "has_sparse_cols", False)
-        return grow_tree(
+        statics = self._serial_grow_statics(hm)
+        grow_fn = grow_tree
+        from ..utils import profiling
+        if (profiling.enabled() and self._forced_splits is None
+                and statics["feature_block"] == 0
+                and jax.process_count() == 1):
+            # TIMETAG runs drive the host-phased grower so the hist_pass /
+            # split_search / apply_split sub-scopes are attributable per
+            # phase (bit-identical trees; see grow_tree_phased)
+            from .grower import grow_tree_phased
+            grow_fn = grow_tree_phased
+        return grow_fn(
             ts.bins, gc, hc, mask,
             ts.feature_meta, self.split_params, fmask, ts.missing_bin,
             binsT=ts.bins_T if self._use_binsT(hm) else None,
@@ -1762,7 +1775,7 @@ class GBDT:
             sp_rows=ts.sp_rows if has_sp else None,
             sp_bins=ts.sp_bins if has_sp else None,
             sp_default=ts.sp_default if has_sp else None,
-            **self._serial_grow_statics(hm))
+            **statics)
 
     def _use_binsT(self, hm: str) -> bool:
         """The feature-major bins copy doubles the dominant array; above
@@ -1865,14 +1878,75 @@ class GBDT:
         return (self.config.hist_pallas_interpret
                 and jax.default_backend() != "tpu")
 
-    def _hist_tuning(self, hm: str) -> tuple:
+    def _split_fusion_on(self, hm: str, fb: int = 0) -> bool:
+        """Resolve Config.split_fusion for this booster's configuration.
+
+        "auto" engages the fused split-finding epilogue whenever the
+        numerical non-bundled search is the whole story (the fused scan
+        covers missing-direction both ways, min_data/min_hessian masks
+        and basic monotone constraints; categorical / EFB / forced-split
+        / CEGB / extra_trees / bynode / advanced-monotone semantics stay
+        in find_best_splits, so those configurations keep the classic
+        split phase). "on" raises on an unsupported configuration
+        instead of silently degrading."""
+        cfg = self.config
+        mode = getattr(cfg, "split_fusion", "auto")
+        if mode == "off" or self.train_set is None:
+            return False
+        ts = self.train_set
+        reasons = []
+        if self._parallel_grower is not None:
+            reasons.append("parallel learner")
+        if ts.has_categorical:
+            reasons.append("categorical features")
+        if ts.bundle_meta is not None:
+            reasons.append("EFB bundles")
+        if self._forced_splits is not None:
+            reasons.append("forced splits")
+        if self._cegb_mode != "off":
+            reasons.append("CEGB")
+        if cfg.extra_trees:
+            reasons.append("extra_trees")
+        if self._use_bynode:
+            reasons.append("feature_fraction_bynode")
+        if self._with_monotone and self._mono_mode != "basic":
+            reasons.append(f"{self._mono_mode} monotone constraints")
+        if cfg.feature_contri and min(cfg.feature_contri) <= 0:
+            # the fused path applies the contri multiplier AFTER the
+            # within-feature argmax (find_best_splits applies it per
+            # bin); the two commute only for positive multipliers — a
+            # zero/negative entry flips or flattens the within-feature
+            # order, so those configs keep the classic phase
+            reasons.append("non-positive feature_contri")
+        if self._hist_dp:
+            reasons.append("f64 histograms")
+        if getattr(ts, "has_sparse_cols", False):
+            reasons.append("sparse device columns")
+        if fb:
+            reasons.append("memory-bounded (feature-blocked) growth")
+        if mode == "on" and reasons:
+            raise ValueError(
+                "split_fusion=on is unsupported with "
+                + ", ".join(reasons)
+                + " (these split semantics live in the classic search; "
+                "use split_fusion=auto to fall back automatically)")
+        return not reasons
+
+    def _hist_tuning(self, hm: str, epilogue: bool = False) -> tuple:
         """(tile_leaves, hist_block) for the serial grow statics: explicit
         config values always win; otherwise the Pallas autotuner supplies
         the measured block size and structural leaf batch for this shape
         bucket (ops/pallas_hist.py autotune_hist — a no-op returning
         defaults off-TPU and for non-Pallas methods). Cached on the
         booster: the statics must stay stable across iterations or every
-        tree would re-jit the grower."""
+        tree would re-jit the grower.
+
+        ``epilogue`` (the resolved split_fusion flag) keys the sweep: the
+        epilogue changes the kernel's block-shape economics, and a
+        ``_hist_tuned`` dict ridden in from a pre-fusion checkpoint
+        (trainer state) must NOT replay a block tuned for the
+        plane-returning kernel into the epilogue kernel — a cached dict
+        whose epilogue key mismatches is discarded and re-measured."""
         cfg = self.config
         tile, blk = cfg.tile_leaves, cfg.hist_block
         if (not cfg.hist_autotune or not hm.startswith("pallas")
@@ -1886,16 +1960,23 @@ class GBDT:
             from ..ops.pallas_hist import structural_tile_leaves
             return tile or structural_tile_leaves(), blk
         hit = getattr(self, "_hist_tuned", None)
+        if hit is not None and hit.get("epilogue", False) != epilogue:
+            # pre-fusion (or cross-mode) ride from a resumed checkpoint:
+            # the tuned block belongs to the OTHER kernel form
+            log.info("pallas hist autotune: cached shape was tuned with "
+                     f"epilogue={hit.get('epilogue', False)}; re-tuning "
+                     f"for epilogue={epilogue}")
+            hit = None
         if hit is None:
             binsT = (self.train_set.bins_T if self._use_binsT(hm) else None)
             if binsT is None:
-                hit = {"block": 0, "tile_leaves": 0}
+                hit = {"block": 0, "tile_leaves": 0, "epilogue": epilogue}
             else:
                 from ..ops.pallas_hist import autotune_hist
                 hit = autotune_hist(
                     binsT, self.train_set.max_num_bins,
                     mode={"pallas": "highest", "pallas_hilo": "hilo",
-                          "pallas_q8": "q8"}[hm])
+                          "pallas_q8": "q8"}[hm], epilogue=epilogue)
             self._hist_tuned = hit
         return tile or hit["tile_leaves"], blk or hit["block"]
 

@@ -476,19 +476,21 @@ def _apply_split(state: GrowState, bins: jax.Array, binsT: jax.Array | None,
     return state, gain_eff
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("max_leaves", "num_bins", "max_depth", "hist_method",
-                     "exact", "axis_name", "with_categorical", "with_monotone",
-                     "mono_mode", "mono_features",
-                     "with_interactions", "cegb_mode", "extra_trees",
-                     "use_bynode", "tile_leaves", "hist_block",
-                     "hist_subtraction", "feature_block",
-                     "feature_axis_name", "feature_shards", "voting",
-                     "vote_top_k", "hist_dp", "sp_cols",
-                     "compaction_ladder", "hist_interpret",
-                     "numerics_sentinels"))
-def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+# the static (compile-time) grow options — ONE definition shared by the
+# monolithic grow_tree jit and the phased per-round programs
+_GROW_STATICS = ("max_leaves", "num_bins", "max_depth", "hist_method",
+                 "exact", "axis_name", "with_categorical", "with_monotone",
+                 "mono_mode", "mono_features",
+                 "with_interactions", "cegb_mode", "extra_trees",
+                 "use_bynode", "tile_leaves", "hist_block",
+                 "hist_subtraction", "feature_block",
+                 "feature_axis_name", "feature_shards", "voting",
+                 "vote_top_k", "hist_dp", "sp_cols",
+                 "compaction_ladder", "hist_interpret",
+                 "numerics_sentinels", "split_fusion")
+
+
+def _grower_fns(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
               feature_mask: jax.Array, missing_bin: jax.Array, *,
               max_leaves: int, num_bins: int, max_depth: int = -1,
@@ -531,8 +533,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
               compaction_ladder: tuple = (),
               hist_interpret: bool = False,
               numerics_sentinels: bool = False,
-              ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
-    """Grow one tree. Returns (tree arrays, per-row leaf index, aux state).
+              split_fusion: bool = False,
+              ) -> dict:
+    """Build the grow program's phase functions (closure factory).
+
+    ``grow_tree`` runs them inside one jitted ``lax.while_loop``;
+    ``grow_tree_phased`` runs the SAME functions as separate per-round
+    jitted programs so each phase is host-timeable (the hist_pass /
+    split_search / apply_split TIMETAG sub-scopes). Grow one tree;
+    finalize returns (tree arrays, per-row leaf index, aux state).
 
     Args:
       bins: [N, F] binned features (device-resident, uint8/int32).
@@ -584,6 +593,18 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         remains the fallback rung (chosen via lax.cond inside the jitted
         while_loop, so every rung is compiled once). Empty = always
         full-N. Serial learner only.
+      split_fusion: the fused split-finding epilogue + frontier batching
+        (ISSUE 12): every tile pass ALSO reduces each (leaf, feature) to
+        its best numerical split candidate — in kernel on the Pallas
+        methods (ops/pallas_hist.py epilogue kernels), via the identical
+        XLA twin elsewhere — with sibling pairs sharing the launch on
+        adjacent slot pairs and the larger child's plane derived in-pass
+        as parent - smaller. state.best is maintained incrementally and
+        the split phase consumes it directly: no [L, F, B, S] plane ever
+        re-enters the search. Bit-identical trees to the classic phase
+        (the parity suite pins it); serial learner, numerical non-bundled
+        search only (see the gate asserts — the gbdt layer resolves
+        Config.split_fusion="auto" off when unsupported).
       feature_block: > 0 engages the MEMORY-BOUNDED mode for wide datasets:
         no [L, F, B, 3] histogram state is kept at all — each pending leaf
         is histogrammed and searched immediately, ``feature_block`` columns
@@ -652,6 +673,21 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             "ladder for parallel/blocked learners")
         assert tuple(sorted(compaction_ladder)) == tuple(compaction_ladder), (
             "compaction_ladder must be ascending")
+    if split_fusion:
+        assert (axis_name is None and feature_axis_name is None
+                and not voting and feature_block == 0), (
+            "split_fusion is serial-only; the caller resolves 'auto' off "
+            "for parallel/blocked learners")
+        assert (not with_categorical and bundle_meta is None
+                and forced_splits is None and cegb_mode == "off"
+                and not extra_trees and not use_bynode and not hist_dp
+                and not f_sp), (
+            "split_fusion covers the numerical non-bundled search only "
+            "(no categorical/EFB/forced-splits/CEGB/extra_trees/bynode/"
+            "f64/sparse) — those semantics stay in find_best_splits and "
+            "the caller resolves 'auto' off when they apply")
+        assert (not with_monotone) or mono_mode == "basic", (
+            "split_fusion supports only basic monotone constraints")
     L = max_leaves
     tile_leaves = tile_leaves or 42     # 0 = auto
     P = min(tile_leaves, L) if hist_method.startswith(("onehot", "pallas")) \
@@ -1078,8 +1114,15 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             tile = jax.lax.psum(tile, axis_name)
             coll = tile_bytes
         if quant8:
-            # collectives ran on exact int32 sums; dequantize once here
-            tile = tile.astype(hist_dtype) * q_scale[None, None, None, :]
+            # collectives ran on exact int32 sums; dequantize once here.
+            # The product passes the rounding fence so the sibling
+            # subtraction below cannot FMA-contract it (ops/split.py
+            # _round_fence — keeps q8 ladder-invariant and bit-matched
+            # with the fused epilogue's identically-fenced dequant)
+            from ..ops.split import _round_fence
+            tile = _round_fence(
+                tile.astype(hist_dtype) * q_scale[None, None, None, :],
+                params)
 
         computed = jnp.zeros((L,), bool).at[chosen].set(chosen_ok)
         buf = jnp.zeros_like(state.hist).at[chosen].set(
@@ -1104,6 +1147,167 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             rounds=state.rounds + 1,
             rows_streamed=state.rows_streamed + streamed,
             coll_bytes=state.coll_bytes + jnp.float32(coll))
+
+    def tile_pass_fused(state: GrowState) -> GrowState:
+        """Frontier-batched histogram pass WITH the fused split epilogue
+        (split_fusion): sibling pairs share the launch on adjacent slot
+        pairs — the computed (smaller) child at even slots, the derived
+        sibling at odd slots, its plane built in-pass as parent - computed
+        so it costs no data pass — and the per-(leaf, feature) best-split
+        candidates come back alongside the planes
+        (ops/histogram.py histogram_tiles_with_candidates). state.best is
+        updated in place for every resolved leaf, so the split phase
+        never re-reads the [L, F, B, S] planes."""
+        from ..ops.histogram import histogram_tiles_with_candidates
+        from ..ops.pallas_hist import (pack_feature_meta, pack_leaf_aux,
+                                       pack_scan_params)
+        from ..ops.split import candidates_to_splitinfo
+        pending = pending_mask(state)
+        sibc = jnp.maximum(state.sib, 0)
+        has_sib = state.sib >= 0
+        p_slot = jnp.minimum(iota_l, sibc)
+        sib_pending = pending[sibc] & has_sib
+        if hist_subtraction:
+            derivable = (pending & sib_pending & state.parent_hist[p_slot])
+            cnt_sib = state.leaf_cnt[sibc]
+            is_smaller = ((state.leaf_cnt < cnt_sib)
+                          | ((state.leaf_cnt == cnt_sib) & (iota_l < sibc)))
+            cand = pending & (~derivable | is_smaller)
+            npairs = max(P // 2, 1)
+            order = jnp.argsort(jnp.where(cand, iota_l, L + iota_l))
+            chosen = order[:npairs].astype(jnp.int32)
+            chosen_ok = cand[chosen]
+            sel_even = jnp.where(chosen_ok, chosen, -1)
+            partner = sibc[chosen].astype(jnp.int32)
+            partner_ok = chosen_ok & derivable[chosen]
+            sel_odd = jnp.where(partner_ok, partner, -1)
+            sel = jnp.stack([sel_even, sel_odd], axis=1).reshape(-1)
+            derive = jnp.stack([jnp.zeros_like(partner_ok), partner_ok],
+                               axis=1).reshape(-1)
+        else:
+            order = jnp.argsort(jnp.where(pending, iota_l, L + iota_l))
+            chosen = order[:P].astype(jnp.int32)
+            chosen_ok = pending[chosen]
+            sel = jnp.where(chosen_ok, chosen, -1)
+            derive = jnp.zeros((P,), bool)
+        p2 = sel.shape[0]
+        selc = jnp.maximum(sel, 0)
+        ok = sel >= 0
+
+        hist_leaf_ids = state.leaf_id_sub if use_subset else state.leaf_id
+        n_rows = hist_leaf_ids.shape[0]
+
+        # parent planes for the derived slots: the one plane-sized read
+        # the in-pass subtraction needs (the parent's histogram is still
+        # resident at the slot the left child inherited)
+        parent_planes = jnp.where(
+            derive[:, None, None, None],
+            jnp.take(state.hist, p_slot[selc], axis=0).astype(jnp.float32),
+            0.0)
+
+        la = pack_leaf_aux(
+            state.leaf_sum_g[selc], state.leaf_sum_h[selc],
+            state.leaf_cnt[selc], state.leaf_output[selc],
+            state.leaf_min[selc].astype(jnp.float32) if with_monotone
+            else None,
+            state.leaf_max[selc].astype(jnp.float32) if with_monotone
+            else None)
+        fm_pack = pack_feature_meta(meta.num_bins, meta.missing_type,
+                                    meta.default_bin, meta.monotone)
+        pvec = pack_scan_params(params)
+        sel_compute = jnp.where(derive, -1, sel)
+
+        from ..ops.histogram import (derive_and_scan, epilogue_supported,
+                                     histogram_tiles)
+        in_kernel = epilogue_supported(hist_method, binsT_h, p2,
+                                       stats.shape[1], hist_dtype,
+                                       hist_interpret)
+
+        def fused_pass(gather_idx, streamed):
+            def fn():
+                if in_kernel:
+                    # the whole epilogue runs IN KERNEL: the candidate
+                    # table comes back with the planes, per rung branch
+                    tile, tab = histogram_tiles_with_candidates(
+                        bins_h, stats, hist_leaf_ids, sel, derive,
+                        parent_planes, la, fm_pack, pvec, num_bins,
+                        method=hist_method, block=hist_block,
+                        dtype=hist_dtype, binsT=binsT_h,
+                        gather_idx=gather_idx, interpret=hist_interpret,
+                        with_monotone=with_monotone, q_scale=q_scale)
+                else:
+                    # XLA twin: the rung branches return only the raw
+                    # tile; the (identical) derive + scan runs ONCE
+                    # after the cond, so it compiles once per grower,
+                    # not once per rung
+                    tile = histogram_tiles(
+                        bins_h, stats, hist_leaf_ids, sel_compute,
+                        num_bins, method=hist_method, block=hist_block,
+                        dtype=hist_dtype, binsT=binsT_h,
+                        gather_idx=gather_idx, interpret=hist_interpret)
+                    tab = None
+                return tile, tab, jnp.float32(streamed)
+            return fn
+
+        if f_dense > 0 and compaction_ladder:
+            slot_map = jnp.full((L + 1,), p2, jnp.int32).at[
+                jnp.where(sel_compute >= 0, sel_compute, L)].set(
+                    jnp.arange(p2, dtype=jnp.int32))
+            in_tile = slot_map[hist_leaf_ids] < p2
+            n_pend = jnp.sum(in_tile, dtype=jnp.int32)
+
+            def compact_pass(m):
+                def fn():
+                    from ..ops.histogram import compact_indices
+                    idx = compact_indices(in_tile, m)
+                    return fused_pass(idx, m)()
+                return fn
+
+            branch = fused_pass(None, n_rows)
+            for m in sorted(compaction_ladder, reverse=True):
+                branch = (lambda m=m, nxt=branch:
+                          jax.lax.cond(n_pend <= m, compact_pass(m),
+                                       lambda: nxt()))
+            tile, tab, streamed = branch()
+        else:
+            tile, tab, streamed = fused_pass(None, n_rows)()
+        if not in_kernel:
+            tile, tab = derive_and_scan(
+                tile, derive, parent_planes, la, fm_pack, pvec,
+                q8=quant8, q_scale=q_scale, with_monotone=with_monotone)
+
+        # scatter planes (computed AND derived — both stay resident as
+        # the next level's parents) and the per-leaf bests
+        slots = jnp.where(ok, sel, L)
+        buf = jnp.zeros_like(state.hist).at[slots].set(
+            jnp.where(ok[:, None, None, None], tile.astype(hist_dtype),
+                      0.0), mode="drop")
+        resolved = jnp.zeros((L,), bool).at[slots].set(ok, mode="drop")
+        hist = jnp.where(resolved[:, None, None, None], buf, state.hist)
+
+        round_key = jax.random.fold_in(rng_key, state.rounds)
+        fmask_sel = leaf_feature_mask(state, round_key)[selc]
+        info = candidates_to_splitinfo(
+            tab, state.leaf_sum_g[selc], state.leaf_sum_h[selc],
+            state.leaf_cnt[selc], state.leaf_output[selc],
+            state.leaf_depth[selc], meta, params, fmask_sel, max_depth,
+            cat_words, with_monotone=with_monotone,
+            leaf_min=(state.leaf_min[selc].astype(jnp.float32)
+                      if with_monotone else None),
+            leaf_max=(state.leaf_max[selc].astype(jnp.float32)
+                      if with_monotone else None))
+
+        def scat(cur, new):
+            return cur.at[slots].set(new.astype(cur.dtype), mode="drop")
+
+        new_best = SplitInfo(*(scat(c, nb)
+                               for c, nb in zip(state.best, info)))
+        return state._replace(
+            hist=hist, best=new_best,
+            hist_valid=state.hist_valid | resolved,
+            parent_hist=state.parent_hist & ~resolved,
+            rounds=state.rounds + 1,
+            rows_streamed=state.rows_streamed + streamed)
 
     def intermediate_bounds(state: GrowState) -> GrowState:
         """Exact per-leaf output bounds from ALL current leaf outputs and
@@ -1153,7 +1357,13 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                         for a in adv)
         return adv
 
-    def split_phase(state: GrowState) -> GrowState:
+    def split_search(state: GrowState) -> GrowState:
+        """Best-split search over all resident histograms -> state.best.
+        Under ``split_fusion`` the search already happened in the tile
+        passes' epilogues (state.best is incrementally maintained), so
+        this reduces to the round bookkeeping."""
+        if split_fusion:
+            return state._replace(rounds=state.rounds + 1)
         adv = None
         if mono_intermediate:
             state = intermediate_bounds(state)
@@ -1237,13 +1447,16 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             from ..ops.split import sync_best_splits
             best = best._replace(feature=best.feature + off)
             best = sync_best_splits(best, feature_axis_name)
-        num_leaves_before = state.num_leaves
-        state = state._replace(best=best, rounds=state.rounds + 1,
-                               coll_bytes=state.coll_bytes
-                               + jnp.float32(coll))
+        return state._replace(best=best, rounds=state.rounds + 1,
+                              coll_bytes=state.coll_bytes
+                              + jnp.float32(coll))
 
+    def split_apply(state: GrowState) -> GrowState:
+        """Apply every available split from state.best (gain order via the
+        inner while_loop; one split under ``exact``)."""
+        num_leaves_before = state.num_leaves
         gain_eff = jnp.where(active_mask(state) & state.hist_valid
-                             & ~state.leaf_dead, best.gain, NEG_INF)
+                             & ~state.leaf_dead, state.best.gain, NEG_INF)
         state = apply_splits(state, gain_eff, dict(
             with_monotone=with_monotone,
             with_interactions=with_interactions,
@@ -1251,6 +1464,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             mono_intermediate=mono_intermediate,
             sub_bins=sub_bins, sub_binsT=sub_binsT, sp=sp_pack))
         return state._replace(done=state.num_leaves == num_leaves_before)
+
+    def split_phase(state: GrowState) -> GrowState:
+        return split_apply(split_search(state))
 
     def forced_phase(state: GrowState) -> GrowState:
         """Apply one forced split (reference: SerialTreeLearner::ForceSplits,
@@ -1445,7 +1661,9 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             sub_bins=None, sub_binsT=None, sp=sp_pack))
         return state._replace(done=state.num_leaves == num_leaves_before)
 
-    def outer_body(state: GrowState) -> GrowState:
+    hist_phase = tile_pass_fused if split_fusion else tile_pass
+
+    def dead_guard(state: GrowState) -> GrowState:
         # BeforeFindBestSplit guards (serial_tree_learner.cpp:282-322): a
         # leaf failing the 2x min-data/min-hessian check is never
         # histogrammed and never splittable
@@ -1453,7 +1671,10 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
         guard = ((state.leaf_cnt >= 2.0 * params.min_data_in_leaf)
                  & (state.leaf_sum_h >= 2.0 * params.min_sum_hessian_in_leaf))
         newly_dead = active & ~state.hist_valid & ~state.leaf_dead & ~guard
-        state = state._replace(leaf_dead=state.leaf_dead | newly_dead)
+        return state._replace(leaf_dead=state.leaf_dead | newly_dead)
+
+    def outer_body(state: GrowState) -> GrowState:
+        state = dead_guard(state)
         if blocked:
             return jax.lax.cond(jnp.any(pending_mask(state)),
                                 blocked_pass, split_phase_blocked, state)
@@ -1465,36 +1686,218 @@ def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                                     forced_phase, split_phase, st)
 
             return jax.lax.cond(jnp.any(pending_mask(state)),
-                                tile_pass, no_pending, state)
+                                hist_phase, no_pending, state)
         return jax.lax.cond(jnp.any(pending_mask(state)),
-                            tile_pass, split_phase, state)
+                            hist_phase, split_phase, state)
 
-    state = jax.lax.while_loop(outer_cond, outer_body, init_state())
-    rows_streamed = state.rows_streamed
-    if axis_name is not None:
-        # global rows per tree across the row shards (each shard counted
-        # only its local rows)
-        rows_streamed = jax.lax.psum(rows_streamed, axis_name)
-    # histogram-plane numerics sentinel (see GrowAux.sentinel): judged on
-    # the FINAL grow state, in-program — the per-leaf grad/hess sums and
-    # outputs integrate every histogram the tree consumed (a NaN entering
-    # any pass lands in some leaf's sums), and the resident histogram
-    # state is checked directly where it exists (the blocked mode holds
-    # only a dummy). A constant 0 when the static is off, so the disarmed
-    # program is unchanged.
-    if numerics_sentinels:
-        bad = (jnp.any(~jnp.isfinite(state.leaf_sum_g))
-               | jnp.any(~jnp.isfinite(state.leaf_sum_h))
-               | jnp.any(~jnp.isfinite(state.leaf_output)))
-        if not blocked:
-            bad = bad | jnp.any(~jnp.isfinite(state.hist))
-        sentinel = bad.astype(jnp.float32)
+    def finalize(state: GrowState):
+        rows_streamed = state.rows_streamed
         if axis_name is not None:
-            sentinel = jax.lax.psum(sentinel, axis_name)
-    else:
-        sentinel = jnp.float32(0.0)
-    # coll_bytes is already the per-device receive volume and identical on
-    # every shard — no psum (a psum would scale it by the mesh size)
-    return state.tree, state.leaf_id, GrowAux(state.used_split,
-                                              state.row_used, rows_streamed,
-                                              state.coll_bytes, sentinel)
+            # global rows per tree across the row shards (each shard
+            # counted only its local rows)
+            rows_streamed = jax.lax.psum(rows_streamed, axis_name)
+        # histogram-plane numerics sentinel (see GrowAux.sentinel): judged
+        # on the FINAL grow state, in-program — the per-leaf grad/hess
+        # sums and outputs integrate every histogram the tree consumed (a
+        # NaN entering any pass lands in some leaf's sums), and the
+        # resident histogram state is checked directly where it exists
+        # (the blocked mode holds only a dummy). A constant 0 when the
+        # static is off, so the disarmed program is unchanged.
+        if numerics_sentinels:
+            bad = (jnp.any(~jnp.isfinite(state.leaf_sum_g))
+                   | jnp.any(~jnp.isfinite(state.leaf_sum_h))
+                   | jnp.any(~jnp.isfinite(state.leaf_output)))
+            if not blocked:
+                bad = bad | jnp.any(~jnp.isfinite(state.hist))
+            sentinel = bad.astype(jnp.float32)
+            if axis_name is not None:
+                sentinel = jax.lax.psum(sentinel, axis_name)
+        else:
+            sentinel = jnp.float32(0.0)
+        # coll_bytes is already the per-device receive volume and
+        # identical on every shard — no psum (a psum would scale it by
+        # the mesh size)
+        return state.tree, state.leaf_id, GrowAux(
+            state.used_split, state.row_used, rows_streamed,
+            state.coll_bytes, sentinel)
+
+    return {"init_state": init_state, "dead_guard": dead_guard,
+            "outer_cond": outer_cond, "outer_body": outer_body,
+            "hist_phase": hist_phase, "split_search": split_search,
+            "split_apply": split_apply, "pending_mask": pending_mask,
+            "finalize": finalize, "phased_ok": (not blocked
+                                               and forced_splits is None)}
+
+
+# dynamic (array) grow kwargs, in the canonical order the phased programs
+# receive them as one tuple operand
+_GROW_DYN = ("interaction_groups", "cegb_coupled", "cegb_lazy_penalty",
+             "cegb_state", "bynode_fraction", "rng_key", "binsT", "sub_idx",
+             "sub_bins", "sub_binsT", "bundle_meta", "forced_splits",
+             "sp_rows", "sp_bins", "sp_default")
+
+
+@functools.partial(jax.jit, static_argnames=_GROW_STATICS)
+def grow_tree(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+              sample_mask: jax.Array, meta: FeatureMeta, params: SplitParams,
+              feature_mask: jax.Array, missing_bin: jax.Array, *,
+              max_leaves: int, num_bins: int, max_depth: int = -1,
+              hist_method: str = "scatter",
+              exact: bool = False,
+              with_categorical: bool = False,
+              with_monotone: bool = False,
+              mono_mode: str = "basic",
+              mono_features: tuple = (),
+              with_interactions: bool = False,
+              interaction_groups: jax.Array | None = None,
+              cegb_mode: str = "off",
+              cegb_coupled: jax.Array | None = None,
+              cegb_lazy_penalty: jax.Array | None = None,
+              cegb_state: GrowAux | None = None,
+              extra_trees: bool = False,
+              use_bynode: bool = False,
+              bynode_fraction: jax.Array | None = None,
+              rng_key: jax.Array | None = None,
+              axis_name: str | None = None,
+              binsT: jax.Array | None = None,
+              sub_idx: jax.Array | None = None,
+              sub_bins: jax.Array | None = None,
+              sub_binsT: jax.Array | None = None,
+              tile_leaves: int = 0,
+              hist_block: int = 0,
+              hist_subtraction: bool = True,
+              feature_block: int = 0,
+              feature_axis_name: str | None = None,
+              feature_shards: int = 1,
+              voting: bool = False,
+              vote_top_k: int = 20,
+              bundle_meta=None,
+              forced_splits=None,
+              hist_dp: bool = False,
+              sp_cols: tuple = (),
+              sp_rows: jax.Array | None = None,
+              sp_bins: jax.Array | None = None,
+              sp_default: jax.Array | None = None,
+              compaction_ladder: tuple = (),
+              hist_interpret: bool = False,
+              numerics_sentinels: bool = False,
+              split_fusion: bool = False,
+              ) -> Tuple[TreeArrays, jax.Array, GrowAux]:
+    """Grow one tree as ONE jitted program (see _grower_fns for the full
+    argument contract). Returns (tree arrays, per-row leaf index, aux)."""
+    fns = _grower_fns(
+        bins, grad, hess, sample_mask, meta, params, feature_mask,
+        missing_bin, max_leaves=max_leaves, num_bins=num_bins,
+        max_depth=max_depth, hist_method=hist_method, exact=exact,
+        with_categorical=with_categorical, with_monotone=with_monotone,
+        mono_mode=mono_mode, mono_features=mono_features,
+        with_interactions=with_interactions,
+        interaction_groups=interaction_groups, cegb_mode=cegb_mode,
+        cegb_coupled=cegb_coupled, cegb_lazy_penalty=cegb_lazy_penalty,
+        cegb_state=cegb_state, extra_trees=extra_trees,
+        use_bynode=use_bynode, bynode_fraction=bynode_fraction,
+        rng_key=rng_key, axis_name=axis_name, binsT=binsT, sub_idx=sub_idx,
+        sub_bins=sub_bins, sub_binsT=sub_binsT, tile_leaves=tile_leaves,
+        hist_block=hist_block, hist_subtraction=hist_subtraction,
+        feature_block=feature_block, feature_axis_name=feature_axis_name,
+        feature_shards=feature_shards, voting=voting, vote_top_k=vote_top_k,
+        bundle_meta=bundle_meta, forced_splits=forced_splits,
+        hist_dp=hist_dp, sp_cols=sp_cols, sp_rows=sp_rows, sp_bins=sp_bins,
+        sp_default=sp_default, compaction_ladder=compaction_ladder,
+        hist_interpret=hist_interpret,
+        numerics_sentinels=numerics_sentinels, split_fusion=split_fusion)
+    state = jax.lax.while_loop(fns["outer_cond"], fns["outer_body"],
+                               fns["init_state"]())
+    return fns["finalize"](state)
+
+
+@functools.lru_cache(maxsize=8)
+def _phased_programs(statics_items: tuple):
+    """Per-config jitted phase programs for the host-driven grower (the
+    hist_pass / split_search / apply_split TIMETAG sub-scopes). Statics
+    fold in via this cache's key; arrays arrive as explicit operands, so
+    no dataset-sized closure constants reach XLA (the PR 10 lesson).
+
+    Each per-round program also returns (any-pending, continue) flags
+    computed on the post-phase state with the next round's dead-guard
+    already folded in (idempotent — the guard depends only on leaf
+    aggregates), so the host's branch decisions reproduce the monolithic
+    while_loop's guard-then-branch order bit-exactly."""
+    skw = dict(statics_items)
+
+    def _fns(arrs, dyn):
+        bins, grad, hess, sample_mask, meta, params, fmask, missing_bin = \
+            arrs
+        return _grower_fns(bins, grad, hess, sample_mask, meta, params,
+                           fmask, missing_bin,
+                           **dict(zip(_GROW_DYN, dyn)), **skw)
+
+    def init(arrs, dyn):
+        fns = _fns(arrs, dyn)
+        state = fns["dead_guard"](fns["init_state"]())
+        return (state, jnp.any(fns["pending_mask"](state)),
+                fns["outer_cond"](state))
+
+    def mk(phase):
+        def run(state, arrs, dyn):
+            fns = _fns(arrs, dyn)
+            if phase == "tile":
+                state = fns["dead_guard"](fns["hist_phase"](state))
+            elif phase == "search":
+                state = fns["split_search"](state)
+            else:
+                state = fns["dead_guard"](fns["split_apply"](state))
+            return (state, jnp.any(fns["pending_mask"](state)),
+                    fns["outer_cond"](state))
+        return jax.jit(run)
+
+    def fin(state, arrs, dyn):
+        return _fns(arrs, dyn)["finalize"](state)
+
+    return {"init": jax.jit(init), "tile": mk("tile"),
+            "search": mk("search"), "apply": mk("apply"),
+            "finalize": jax.jit(fin)}
+
+
+def grow_tree_phased(bins, grad, hess, sample_mask, meta, params,
+                     feature_mask, missing_bin, **kw):
+    """Host-driven grow loop with per-phase TIMETAG scopes.
+
+    The SAME _grower_fns phases as grow_tree, but each round is its own
+    compiled dispatch so ``hist_pass`` / ``split_search`` / ``apply_split``
+    wall time is attributable per phase (bench.py's sub-scope probe; the
+    reference's per-phase USE_TIMETAG table). The host fetches two
+    booleans per ROUND — with frontier batching that is one histogram
+    launch per frontier level, not per leaf (the dispatch-count
+    regression pins it). Bit-identical trees to grow_tree; serial
+    non-blocked non-forced configurations only (callers fall back to
+    grow_tree otherwise).
+    """
+    from ..utils import profiling
+    statics = tuple(sorted((k, v) for k, v in kw.items()
+                           if k in _GROW_STATICS))
+    dyn = tuple(kw.get(k) for k in _GROW_DYN)
+    unknown = set(kw) - set(_GROW_STATICS) - set(_GROW_DYN)
+    assert not unknown, f"grow_tree_phased: unsupported kwargs {unknown}"
+    assert not kw.get("axis_name") and not kw.get("feature_axis_name"), (
+        "grow_tree_phased is serial-only")
+    assert kw.get("forced_splits") is None and not kw.get("feature_block"), (
+        "grow_tree_phased: forced splits / blocked mode unsupported")
+    arrs = (bins, grad, hess, sample_mask, meta, params, feature_mask,
+            missing_bin)
+    progs = _phased_programs(statics)
+    state, pending, cont = progs["init"](arrs, dyn)
+    pending, cont = bool(pending), bool(cont)
+    while cont:
+        if pending:
+            with profiling.timer("hist_pass"):
+                state, p2, c2 = progs["tile"](state, arrs, dyn)
+                pending, cont = bool(p2), bool(c2)
+        else:
+            with profiling.timer("split_search"):
+                state, _, _ = progs["search"](state, arrs, dyn)
+                state.best.gain.block_until_ready()
+            with profiling.timer("apply_split"):
+                state, p2, c2 = progs["apply"](state, arrs, dyn)
+                pending, cont = bool(p2), bool(c2)
+    return progs["finalize"](state, arrs, dyn)

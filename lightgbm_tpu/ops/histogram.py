@@ -480,3 +480,107 @@ def histogram_tiles(bins: jax.Array, stats: jax.Array, leaf_ids: jax.Array,
         onehot = eq.astype(dtype)
         return histogram_binloop(bins, stats.astype(dtype), onehot, num_bins)
     raise ValueError(f"unknown histogram method: {method}")
+
+
+def epilogue_supported(method: str, binsT, p: int, s: int, dtype,
+                       interpret: bool = False) -> bool:
+    """Whether the IN-KERNEL form of the split epilogue can run (same
+    preconditions as the plain pallas kernels). When False,
+    histogram_tiles_with_candidates runs the XLA twin of the identical
+    epilogue math instead — the fused-search path works on every backend,
+    only the kernel fusion degrades."""
+    if method not in ("pallas", "pallas_hilo", "pallas_q8"):
+        return False
+    if jax.default_backend() != "tpu" and not interpret:
+        return False
+    if binsT is None or p * s > 128 or s != 3:
+        return False
+    return dtype == jnp.float32 or method == "pallas_q8"
+
+
+def histogram_tiles_with_candidates(bins, stats, leaf_ids, sel, derive,
+                                    parent_planes, leaf_aux, fmeta, pvec,
+                                    num_bins, method: str = "onehot",
+                                    block: int = 0, dtype=jnp.float32,
+                                    binsT=None, gather_idx=None,
+                                    interpret: bool = False,
+                                    with_monotone: bool = False,
+                                    q_scale=None):
+    """Histogram tile pass + fused split-finding epilogue.
+
+    The frontier-batched unit of the ``split_fusion`` grower path: one
+    launch histograms the tile's COMPUTED leaves (even slots), derives
+    each derived sibling's plane as parent - computed (odd slots, static
+    lane shift in kernel / slot roll in XLA), and reduces every
+    (leaf, feature) to its best numerical split candidate
+    (ops/split.py numerical_candidates). On the Pallas methods the whole
+    epilogue runs IN KERNEL (pallas_hist.histogram_tiles_pallas_epilogue)
+    and only the candidate table + the parent-needed planes leave VMEM;
+    every other backend runs the SAME jnp ops on the tile it built —
+    bit-identical tables by construction (the parity suite pins it).
+
+    Args mirror histogram_tiles plus the epilogue pack (see
+    histogram_tiles_pallas_epilogue). Returns (tile [P, F, B, S] f32
+    with derived planes filled in, cand [P, F, CAND_CHANNELS]).
+    """
+    from . import pallas_hist
+
+    p = sel.shape[0]
+    s = stats.shape[1]
+    if epilogue_supported(method, binsT, p, s, dtype, interpret):
+        kmode = {"pallas": "highest", "pallas_hilo": "hilo",
+                 "pallas_q8": "q8"}[method]
+        return pallas_hist.histogram_tiles_pallas_epilogue(
+            binsT, stats, leaf_ids, sel, derive, parent_planes, leaf_aux,
+            fmeta, pvec, num_bins, block=block or 2048, mode=kmode,
+            idx=gather_idx,
+            interpret=interpret and jax.default_backend() != "tpu",
+            with_monotone=with_monotone, q_scale=q_scale)
+
+    # XLA twin: build the computed slots' planes with the requested
+    # backend, then the identical derive + scan at plane level
+    sel_compute = jnp.where(derive, -1, sel)
+    tile = histogram_tiles(bins, stats, leaf_ids, sel_compute, num_bins,
+                           method=method, block=block, dtype=dtype,
+                           binsT=binsT, gather_idx=gather_idx,
+                           interpret=interpret)
+    return derive_and_scan(tile, derive, parent_planes, leaf_aux, fmeta,
+                           pvec, q8=method.endswith("_q8"),
+                           q_scale=q_scale, with_monotone=with_monotone)
+
+
+def derive_and_scan(tile, derive, parent_planes, leaf_aux, fmeta, pvec, *,
+                    q8: bool = False, q_scale=None,
+                    with_monotone: bool = False):
+    """The XLA twin of the in-kernel split epilogue, at plane level:
+    dequantize (q8, fenced), derive the odd slots' planes as
+    parent - computed-sibling (slot roll == the kernel's static lane
+    shift), scan each slot to its best per-feature candidates. The
+    grower calls this ONCE per tile pass, OUTSIDE the compaction-rung
+    lax.cond — the rung branches return only the tile, so the scan
+    compiles once per grower instead of once per rung."""
+    from . import pallas_hist
+    from .split import _round_fence, numerical_candidates
+
+    params = pallas_hist._epilogue_params(pvec.astype(jnp.float32))
+    if q8:
+        # fence the dequant product before the sibling subtraction —
+        # same reason as the kernel epilogue (see _epilogue_compute):
+        # an FMA-contracted multiply-sub would break ladder invariance
+        tile = _round_fence(
+            tile.astype(jnp.float32) * q_scale[None, None, None, :],
+            params)
+    else:
+        tile = tile.astype(jnp.float32)
+    shifted = jnp.concatenate([jnp.zeros_like(tile[:1]), tile[:-1]], axis=0)
+    full = jnp.where(derive[:, None, None, None],
+                     parent_planes.astype(jnp.float32) - shifted, tile)
+    la = leaf_aux.astype(jnp.float32)
+    fm = fmeta.astype(jnp.float32)
+    cand = numerical_candidates(
+        full, la[:, 0], la[:, 1], la[:, 2], la[:, 3],
+        fm[:, 0].astype(jnp.int32), fm[:, 1].astype(jnp.int32),
+        fm[:, 2].astype(jnp.int32), fm[:, 3].astype(jnp.int32),
+        params, with_monotone=with_monotone,
+        leaf_min=la[:, 4], leaf_max=la[:, 5])
+    return full, cand

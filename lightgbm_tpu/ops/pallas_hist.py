@@ -165,28 +165,16 @@ def _fused_kernel(binsT_ref, leaf_ref, stats_ref, chan_ref, out_ref,
                 chan_ref[0, :], None, out_ref, f=f, b=b, c=c, s=s, mode=mode)
 
 
-def _gather_kernel(idx_ref, binsT_hbm, leaf_hbm, stats_hbm, idxv_ref,
-                   chan_ref, out_ref, bins_s, leaf_s, stats_s,
-                   sem_b, sem_l, sem_s, *, f, b, c, s, mode, n):
-    """Compacted-pass fused kernel (fusion 2): the grid step DMAs the
-    pending rows' bin columns, leaf ids and stats from the HBM-resident
-    FULL arrays into VMEM scratch using the scalar-prefetched row-index
-    buffer, then runs the same compute body. The compacted ``[F, N/r]``
-    copy the XLA ladder used to write/re-read is never materialized.
-
-    Per-row DMA is latency-bound, not bandwidth-bound — the three copy
-    streams (bins column, stats row, leaf id) are issued back-to-back for
-    the whole block before the first wait, so the DMA engines pipeline
-    across rows. ``idx`` entries >= n are ladder padding: their source is
-    clamped to row n-1 and the row is masked out of the leaf match."""
+def _dma_gather_rows(idx_ref, binsT_hbm, leaf_hbm, stats_hbm, bins_s, leaf_s,
+                     stats_s, sem_b, sem_l, sem_s, *, i, c, n):
+    """Shared DMA body of the gather kernels: issue grid step ``i``'s
+    per-row copies back-to-back into the VMEM scratch buffers, then drain
+    them (same src/dst shapes -> same byte counts, so c waits per stream
+    drain exactly the c started copies). Padding entries (idx >= n) clamp
+    to row n-1; the CALLER masks them out of the leaf match via the
+    prefetched index values."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
 
     def _copies(k):
         j = jnp.minimum(idx_ref[i * c + k], n - 1)
@@ -207,13 +195,37 @@ def _gather_kernel(idx_ref, binsT_hbm, leaf_hbm, stats_hbm, idxv_ref,
     jax.lax.fori_loop(0, c, start, 0)
 
     def wait(k, _):
-        # same src/dst shapes as the started copies -> same byte counts,
-        # so c waits per stream drain exactly the c started copies
         for dma in _copies(0):
             dma.wait()
         return 0
 
     jax.lax.fori_loop(0, c, wait, 0)
+
+
+def _gather_kernel(idx_ref, binsT_hbm, leaf_hbm, stats_hbm, idxv_ref,
+                   chan_ref, out_ref, bins_s, leaf_s, stats_s,
+                   sem_b, sem_l, sem_s, *, f, b, c, s, mode, n):
+    """Compacted-pass fused kernel (fusion 2): the grid step DMAs the
+    pending rows' bin columns, leaf ids and stats from the HBM-resident
+    FULL arrays into VMEM scratch using the scalar-prefetched row-index
+    buffer, then runs the same compute body. The compacted ``[F, N/r]``
+    copy the XLA ladder used to write/re-read is never materialized.
+
+    Per-row DMA is latency-bound, not bandwidth-bound — the three copy
+    streams (bins column, stats row, leaf id) are issued back-to-back for
+    the whole block before the first wait, so the DMA engines pipeline
+    across rows. ``idx`` entries >= n are ladder padding: their source is
+    clamped to row n-1 and the row is masked out of the leaf match."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    _dma_gather_rows(idx_ref, binsT_hbm, leaf_hbm, stats_hbm, bins_s,
+                     leaf_s, stats_s, sem_b, sem_l, sem_s, i=i, c=c, n=n)
 
     vmask = idxv_ref[0, :] < n
     _accumulate(bins_s[...], leaf_s[0, :], stats_s[...], chan_ref[0, :],
@@ -404,6 +416,362 @@ def histogram_tiles_pallas_hilo(binsT: jax.Array, stats: jax.Array,
                                        idx=idx, interpret=interpret)
 
 
+# ------------------------------------------------- split-finding epilogue
+#
+# The fused split epilogue (ISSUE 12): after the last grid step has
+# accumulated the tile's histogram planes in VMEM, the kernel (a) derives
+# each DERIVED sibling's plane in-register as parent - computed-sibling —
+# sibling pairs occupy ADJACENT slot pairs (computed even, derived odd),
+# so the sibling's lanes are a STATIC s-lane shift, no dynamic lane
+# gather — and (b) runs the numerical split-gain scan (ops/split.py
+# numerical_candidates, the same jnp ops as the XLA twin) over every
+# slot's plane, reducing each (leaf, feature) to one best candidate.
+# Only the [P, F, CAND_CHANNELS] table and the (still-parent-needed)
+# plane leave VMEM; the grower's split phase never touches [L, F, B, S]
+# planes again.
+
+
+def _epilogue_lanes(sel: jax.Array, derive: jax.Array, s: int,
+                    q_scale=None):
+    """Per-lane epilogue tables: (derive_lane [1, _PAD] int32, qscale_lane
+    [1, _PAD] f32). Lane q belongs to slot p_of_q; derived slots read the
+    sibling's lanes at q - s in the kernel."""
+    p = sel.shape[0]
+    p_of_q, s_of_q, valid = _chan_layout(p, s)
+    dl = (jnp.asarray(valid)
+          & derive[jnp.asarray(p_of_q)]
+          & (sel[jnp.asarray(p_of_q)] >= 0)).astype(jnp.int32)[None, :]
+    if q_scale is None:
+        ql = jnp.ones((1, _PAD), jnp.float32)
+    else:
+        ql = q_scale[jnp.asarray(s_of_q)][None, :].astype(jnp.float32)
+    return dl, ql
+
+
+def _epilogue_params(pv: jax.Array):
+    """Rebuild the 7 numerical-scan SplitParams fields from the packed
+    scalar vector the kernel loads (unused fields zeroed)."""
+    from .split import SplitParams
+    z = jnp.float32(0.0)
+    return SplitParams(
+        lambda_l1=pv[0], lambda_l2=pv[1], max_delta_step=pv[2],
+        path_smooth=pv[3], min_data_in_leaf=pv[4],
+        min_sum_hessian_in_leaf=pv[5], min_gain_to_split=pv[6],
+        cat_l2=z, cat_smooth=z, max_cat_threshold=jnp.int32(0),
+        min_data_per_group=z, max_cat_to_onehot=jnp.int32(0),
+        monotone_penalty=z, cegb_tradeoff=z, cegb_penalty_split=z)
+
+
+def _epilogue_compute(acc, parent, derive_lane, qscale, la, fm, pv, *,
+                      f, b, p, s, mode, with_monotone):
+    """Shared epilogue body (kernel AND the XLA twin go through the same
+    ops): dequantize (q8), derive odd-slot siblings by the static lane
+    shift, then scan. Returns (full plane [F*B, _PAD], cand [P, F, C])."""
+    from .split import _round_fence, numerical_candidates
+    params = _epilogue_params(pv)
+    if mode == "q8":
+        # the dequant product must round to concrete bits BEFORE the
+        # sibling subtraction below — XLA otherwise contracts the
+        # multiply into the subtract (fused multiply-sub) differently
+        # per compilation context (e.g. across compaction-rung branches),
+        # breaking the ladder-invariance the exact integer accumulation
+        # guarantees (see ops/split.py _round_fence)
+        plane = _round_fence(acc.astype(jnp.float32) * qscale, params)
+    else:
+        plane = acc
+    # derived slot q reads its computed sibling at lane q - s (adjacent
+    # slot pair), stat channel preserved
+    shifted = jnp.concatenate(
+        [jnp.zeros((f * b, s), jnp.float32), plane[:, :_PAD - s]], axis=1)
+    full = jnp.where(derive_lane != 0, parent - shifted, plane)
+    pf = full[:, :p * s].reshape(f, b, p, s).transpose(2, 0, 1, 3)
+    cand = numerical_candidates(
+        pf, la[:, 0], la[:, 1], la[:, 2], la[:, 3],
+        fm[:, 0].astype(jnp.int32), fm[:, 1].astype(jnp.int32),
+        fm[:, 2].astype(jnp.int32), fm[:, 3].astype(jnp.int32),
+        params, with_monotone=with_monotone,
+        leaf_min=la[:, 4], leaf_max=la[:, 5])
+    return full, cand
+
+
+def _fused_epi_kernel(binsT_ref, leaf_ref, stats_ref, chan_ref, parent_ref,
+                      la_ref, fm_ref, pv_ref, qs_ref, der_ref,
+                      plane_ref, cand_ref, acc_ref, *,
+                      f, b, c, s, mode, p, nblk, with_monotone):
+    """Full-pass fused kernel WITH the split epilogue: accumulation runs
+    in a VMEM scratch; the last grid step derives siblings, scans, and
+    writes both outputs once."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        plane_ref[...] = jnp.zeros_like(plane_ref)
+        cand_ref[...] = jnp.zeros_like(cand_ref)
+
+    _accumulate(binsT_ref[...], leaf_ref[0, :], stats_ref[...],
+                chan_ref[0, :], None, acc_ref, f=f, b=b, c=c, s=s, mode=mode)
+
+    @pl.when(i == nblk - 1)
+    def _epi():
+        full, cand = _epilogue_compute(
+            acc_ref[...], parent_ref[...], der_ref[...], qs_ref[...],
+            la_ref[...], fm_ref[...], pv_ref[0, :], f=f, b=b, p=p, s=s,
+            mode=mode, with_monotone=with_monotone)
+        plane_ref[...] = full
+        cand_ref[...] = cand
+
+
+def _gather_epi_kernel(idx_ref, binsT_hbm, leaf_hbm, stats_hbm, idxv_ref,
+                       chan_ref, parent_ref, la_ref, fm_ref, pv_ref,
+                       qs_ref, der_ref, plane_ref, cand_ref,
+                       bins_s, leaf_s, stats_s, sem_b, sem_l, sem_s,
+                       acc_ref, *, f, b, c, s, mode, n, p, nblk,
+                       with_monotone):
+    """Compacted-pass fused kernel WITH the split epilogue (in-kernel DMA
+    row gather + scratch accumulation + last-step scan)."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        plane_ref[...] = jnp.zeros_like(plane_ref)
+        cand_ref[...] = jnp.zeros_like(cand_ref)
+
+    _dma_gather_rows(idx_ref, binsT_hbm, leaf_hbm, stats_hbm, bins_s,
+                     leaf_s, stats_s, sem_b, sem_l, sem_s, i=i, c=c, n=n)
+
+    vmask = idxv_ref[0, :] < n
+    _accumulate(bins_s[...], leaf_s[0, :], stats_s[...], chan_ref[0, :],
+                vmask, acc_ref, f=f, b=b, c=c, s=s, mode=mode)
+
+    @pl.when(i == nblk - 1)
+    def _epi():
+        full, cand = _epilogue_compute(
+            acc_ref[...], parent_ref[...], der_ref[...], qs_ref[...],
+            la_ref[...], fm_ref[...], pv_ref[0, :], f=f, b=b, p=p, s=s,
+            mode=mode, with_monotone=with_monotone)
+        plane_ref[...] = full
+        cand_ref[...] = cand
+
+
+def _epi_out_specs(f, num_bins, p):
+    from .split import CAND_CHANNELS
+    return (jax.ShapeDtypeStruct((f * num_bins, _PAD), jnp.float32),
+            jax.ShapeDtypeStruct((p, f, CAND_CHANNELS), jnp.float32))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block", "mode", "interpret",
+                                    "with_monotone"))
+def _fused_epi_call(binsT, leaf2d, stats, chan, parent, la, fm, pv2d, qs,
+                    der, *, num_bins, block, mode, interpret=False,
+                    with_monotone=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    f, n = binsT.shape
+    s = stats.shape[1]
+    p = la.shape[0]
+    c = block
+    nblk = n // c
+    acc_dtype = jnp.int32 if mode == "q8" else jnp.float32
+    kernel = functools.partial(_fused_epi_kernel, f=f, b=num_bins, c=c, s=s,
+                               mode=mode, p=p, nblk=nblk,
+                               with_monotone=with_monotone)
+    kw = ({"interpret": True} if interpret
+          else {"compiler_params": _compiler_params()})
+    const = pl.BlockSpec
+    return pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            const((f, c), lambda i: (0, i)),
+            const((1, c), lambda i: (0, i)),
+            const((c, s), lambda i: (i, 0)),
+            const((1, _PAD), lambda i: (0, 0)),
+            const((f * num_bins, _PAD), lambda i: (0, 0)),   # parent
+            const(la.shape, lambda i: (0, 0)),
+            const(fm.shape, lambda i: (0, 0)),
+            const((1, 8), lambda i: (0, 0)),
+            const((1, _PAD), lambda i: (0, 0)),
+            const((1, _PAD), lambda i: (0, 0)),
+        ],
+        out_specs=(const((f * num_bins, _PAD), lambda i: (0, 0)),
+                   const(_epi_out_specs(f, num_bins, p)[1].shape,
+                         lambda i: (0, 0, 0))),
+        out_shape=_epi_out_specs(f, num_bins, p),
+        scratch_shapes=[pltpu.VMEM((f * num_bins, _PAD), acc_dtype)],
+        **kw,
+    )(binsT, leaf2d, stats, chan, parent, la, fm, pv2d, qs, der)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "block", "mode", "interpret",
+                                    "with_monotone"))
+def _fused_gather_epi_call(idx, binsT, leaf2d, stats, idx2d, chan, parent,
+                           la, fm, pv2d, qs, der, *, num_bins, block, mode,
+                           interpret=False, with_monotone=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    f, n = binsT.shape
+    s = stats.shape[1]
+    p = la.shape[0]
+    m = idx.shape[0]
+    c = block
+    nblk = m // c
+    acc_dtype = jnp.int32 if mode == "q8" else jnp.float32
+    kernel = functools.partial(_gather_epi_kernel, f=f, b=num_bins, c=c,
+                               s=s, mode=mode, n=n, p=p, nblk=nblk,
+                               with_monotone=with_monotone)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),            # binsT
+            pl.BlockSpec(memory_space=pltpu.ANY),            # leaf
+            pl.BlockSpec(memory_space=pltpu.ANY),            # stats
+            pl.BlockSpec((1, c), lambda i, idx_ref: (0, i)),  # idx2d
+            pl.BlockSpec((1, _PAD), lambda i, idx_ref: (0, 0)),
+            pl.BlockSpec((f * num_bins, _PAD),
+                         lambda i, idx_ref: (0, 0)),         # parent
+            pl.BlockSpec(la.shape, lambda i, idx_ref: (0, 0)),
+            pl.BlockSpec(fm.shape, lambda i, idx_ref: (0, 0)),
+            pl.BlockSpec((1, 8), lambda i, idx_ref: (0, 0)),
+            pl.BlockSpec((1, _PAD), lambda i, idx_ref: (0, 0)),
+            pl.BlockSpec((1, _PAD), lambda i, idx_ref: (0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((f * num_bins, _PAD),
+                                lambda i, idx_ref: (0, 0)),
+                   pl.BlockSpec(_epi_out_specs(f, num_bins, p)[1].shape,
+                                lambda i, idx_ref: (0, 0, 0))),
+        scratch_shapes=[
+            pltpu.VMEM((f, c), binsT.dtype),
+            pltpu.VMEM((1, c), jnp.int32),
+            pltpu.VMEM((c, s), stats.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((f * num_bins, _PAD), acc_dtype),
+        ],
+    )
+    kw = ({"interpret": True} if interpret
+          else {"compiler_params": _compiler_params()})
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=_epi_out_specs(f, num_bins, p),
+        **kw,
+    )(idx, binsT, leaf2d, stats, idx2d, chan, parent, la, fm, pv2d, qs, der)
+
+
+def pack_leaf_aux(sum_g, sum_h, cnt, output, leaf_min=None, leaf_max=None):
+    """[P, 8] f32 per-slot leaf aggregates for the epilogue kernel
+    (columns: sum_g, sum_h, cnt, output, min, max, 0, 0)."""
+    p = sum_g.shape[0]
+    big = np.float32(np.finfo(np.float32).max)
+    lmin = (jnp.full((p,), -big) if leaf_min is None
+            else leaf_min.astype(jnp.float32))
+    lmax = (jnp.full((p,), big) if leaf_max is None
+            else leaf_max.astype(jnp.float32))
+    cols = [sum_g, sum_h, cnt, output, lmin, lmax,
+            jnp.zeros((p,)), jnp.zeros((p,))]
+    return jnp.stack([a.astype(jnp.float32) for a in cols], axis=1)
+
+
+def pack_feature_meta(num_bins_f, missing_type_f, default_bin_f, monotone_f):
+    """[F, 8] f32 per-feature scan metadata for the epilogue kernel
+    (columns: num_bins, missing_type, default_bin, monotone, 0...)."""
+    f = num_bins_f.shape[0]
+    cols = [num_bins_f, missing_type_f, default_bin_f, monotone_f]
+    cols = [a.astype(jnp.float32) for a in cols] + [jnp.zeros((f,))] * 4
+    return jnp.stack(cols, axis=1)
+
+
+def pack_scan_params(p) -> jax.Array:
+    """[7] f32 packed numerical-scan SplitParams for the epilogue kernel
+    (inverse of _epilogue_params)."""
+    return jnp.stack([
+        p.lambda_l1, p.lambda_l2, p.max_delta_step, p.path_smooth,
+        p.min_data_in_leaf, p.min_sum_hessian_in_leaf,
+        p.min_gain_to_split]).astype(jnp.float32)
+
+
+def histogram_tiles_pallas_epilogue(binsT, stats, leaf_ids, sel, derive,
+                                    parent_planes, leaf_aux, fmeta, pvec,
+                                    num_bins, block=2048, mode="hilo",
+                                    idx=None, interpret=False,
+                                    with_monotone=False, q_scale=None):
+    """Fused histogram pass + in-kernel split epilogue.
+
+    Args beyond histogram_tiles_pallas_mode:
+      sel: [P] leaf per slot; sibling pairs occupy ADJACENT slot pairs —
+        computed (smaller) sibling at even slots, derived at odd slots.
+        Derived slots accumulate no rows (their chan lanes are dead) and
+        get their plane as parent - computed-sibling in the epilogue.
+      derive: [P] bool marking the derived slots.
+      parent_planes: [P, F, B, S] f32 parent histograms for the derived
+        slots (zeros elsewhere; XLA-gathered from the grower's resident
+        state, the one plane-sized read the subtraction needs).
+      leaf_aux: [P, 8] from pack_leaf_aux.
+      fmeta: [F, 8] from pack_feature_meta.
+      pvec: [7] from pack_scan_params.
+      q_scale: [S] dequant scale for mode="q8" (the grower's per-tree
+        scales; the kernel dequantizes before deriving, so subtraction
+        runs in f32 exactly like the classic XLA flow).
+
+    Returns (tile [P, F, B, S] f32 — derived planes included, resident
+    for the next level's subtraction — and cand [P, F, CAND_CHANNELS]).
+    """
+    f, n = binsT.shape
+    p = sel.shape[0]
+    s = stats.shape[1]
+    assert s == 3, "the split epilogue expects (grad, hess, count) stats"
+    assert p * s <= _PAD, (p, s)
+    sel_compute = jnp.where(derive, -1, sel)
+    chan = chan_leaf_table(sel_compute, s)
+    der, qs = _epilogue_lanes(sel, derive, s,
+                              q_scale if mode == "q8" else None)
+    parent = jnp.zeros((f * num_bins, _PAD), jnp.float32)
+    parent = parent.at[:, :p * s].set(
+        parent_planes.astype(jnp.float32).transpose(1, 2, 0, 3)
+        .reshape(f * num_bins, p * s))
+    la = leaf_aux.astype(jnp.float32)
+    fm = fmeta.astype(jnp.float32)
+    pv2d = jnp.pad(pvec.astype(jnp.float32), (0, 1))[None, :]
+    leaf2d = leaf_ids[None, :].astype(jnp.int32)
+    if mode != "q8":
+        stats = stats.astype(jnp.float32)
+    if idx is not None:
+        c = min(block, max(128, _round_up(idx.shape[0], 128)))
+        mpad = _round_up(idx.shape[0], c)
+        idx = idx.astype(jnp.int32)
+        if mpad != idx.shape[0]:
+            idx = jnp.pad(idx, (0, mpad - idx.shape[0]), constant_values=n)
+        plane, cand = _fused_gather_epi_call(
+            idx, binsT, leaf2d, stats, idx[None, :], chan, parent, la, fm,
+            pv2d, qs, der, num_bins=num_bins, block=c, mode=mode,
+            interpret=interpret, with_monotone=with_monotone)
+    else:
+        c = min(block, max(512, _round_up(n, 512)))
+        pad = _round_up(n, c) - n
+        if pad:
+            binsT = jnp.pad(binsT, ((0, 0), (0, pad)))
+            stats = jnp.pad(stats, ((0, pad), (0, 0)))
+            leaf2d = jnp.pad(leaf2d, ((0, 0), (0, pad)),
+                             constant_values=-2)
+        plane, cand = _fused_epi_call(
+            binsT, leaf2d, stats, chan, parent, la, fm, pv2d, qs, der,
+            num_bins=num_bins, block=c, mode=mode, interpret=interpret,
+            with_monotone=with_monotone)
+    tile = (plane[:, :p * s].reshape(f, num_bins, p, s)
+            .transpose(2, 0, 1, 3))
+    return tile, cand
+
+
 # ---------------------------------------------------------------- roofline
 
 # MXU input-rate multiplier per mode: passes over the same one-hot x rhs
@@ -439,8 +807,20 @@ def traffic_model(n, f, b, p, s, mode="hilo", gathered_rows=None):
     # round-trip HBM (XLA cannot keep either resident across the scan)
     xla_onehot = (common + out_bytes + 2 * m * f * b * oh_b
                   + 2 * m * _PAD * rhs_b)
+    # split-search consumer bytes per LEAF (ISSUE 12): the classic split
+    # phase streams each leaf's [F, B, S=3] f32 histogram plane through
+    # the gain scan's temporaries; the fused epilogue returns only the
+    # [F, CAND_CHANNELS] candidate row — a >= B/4x reduction in bytes
+    # the search reads back from HBM (3*B*4 / (12*4) = exactly B/4 at
+    # the 12-channel layout; kernel_bench asserts the floor from the
+    # REAL returned buffers, not from this model)
+    from .split import CAND_CHANNELS
+    search_in_planes = f * b * s * 4
+    search_in_cand = f * CAND_CHANNELS * 4
     return {"fused": fused, "prefusion": prefusion,
-            "xla_onehot": xla_onehot, "output": out_bytes}
+            "xla_onehot": xla_onehot, "output": out_bytes,
+            "search_in_planes": search_in_planes,
+            "search_in_cand": search_in_cand}
 
 
 # ------------------------------------------------------------- autotuning
@@ -472,7 +852,8 @@ def structural_tile_leaves(stats_channels: int = 3) -> int:
 def autotune_hist(binsT, num_bins: int, mode: str = "hilo",
                   stats_channels: int = 3, sample_rows: int = 262144,
                   block_candidates=BLOCK_CANDIDATES,
-                  force_measure: bool = False) -> dict:
+                  force_measure: bool = False,
+                  epilogue: bool = False) -> dict:
     """Measured kernel-shape tuning, keyed like the predict engine's shape
     buckets: TIME the fused kernel at each candidate row-block size on a
     sampled prefix and cache the winner per (F, B, log2-row-bucket, mode).
@@ -484,15 +865,21 @@ def autotune_hist(binsT, num_bins: int, mode: str = "hilo",
 
     Non-TPU backends return the static defaults without measuring
     (``force_measure`` overrides for tests, running in interpret mode).
-    Returns ``{"block": int, "tile_leaves": int}`` (0 = keep defaults).
+    ``epilogue`` keys the sweep on the kernel FORM — the fused split
+    epilogue changes the block-shape economics (scratch accumulation +
+    the in-kernel scan), so a block tuned for the plane-returning kernel
+    must never ride into the epilogue kernel (ISSUE 12's trainer-state
+    contract; models/gbdt.py _hist_tuning enforces the same rule on
+    checkpoint-ridden dicts). Returns ``{"block": int, "tile_leaves":
+    int, "epilogue": bool}`` (0 = keep defaults).
     """
     import time
 
     tile = structural_tile_leaves(stats_channels)
     if jax.default_backend() != "tpu" and not force_measure:
-        return {"block": 0, "tile_leaves": 0}
+        return {"block": 0, "tile_leaves": 0, "epilogue": epilogue}
     f, n = binsT.shape
-    key = (f, int(num_bins), max(n, 1).bit_length(), mode)
+    key = (f, int(num_bins), max(n, 1).bit_length(), mode, epilogue)
     hit = _tuned.get(key)
     if hit is not None:
         return hit
@@ -503,19 +890,38 @@ def autotune_hist(binsT, num_bins: int, mode: str = "hilo",
     stats = jnp.ones((k, stats_channels), st_dtype)
     lid = jnp.zeros((k,), jnp.int32)
     sel = jnp.zeros((tile,), jnp.int32).at[1:].set(-1)
+    if epilogue:
+        derive = jnp.zeros((tile,), bool)
+        parent = jnp.zeros((tile, f, num_bins, stats_channels), jnp.float32)
+        la = pack_leaf_aux(*(jnp.zeros((tile,)) for _ in range(4)))
+        fmeta = pack_feature_meta(
+            jnp.full((f,), num_bins, jnp.int32),
+            jnp.zeros((f,), jnp.int32), jnp.zeros((f,), jnp.int32),
+            jnp.zeros((f,), jnp.int32))
+        pvec = jnp.zeros((7,), jnp.float32)
+        qsc = (jnp.ones((stats_channels,), jnp.float32)
+               if mode == "q8" else None)
+
+        def run_fn(blk):
+            t, c = histogram_tiles_pallas_epilogue(
+                subT, stats, lid, sel, derive, parent, la, fmeta, pvec,
+                num_bins, block=blk, mode=mode, interpret=interpret,
+                q_scale=qsc)
+            return jnp.sum(t) + jnp.sum(c)
+    else:
+        def run_fn(blk):
+            return jnp.sum(histogram_tiles_pallas_mode(
+                subT, stats, lid, sel, num_bins, block=blk, mode=mode,
+                interpret=interpret))
     times = {}
     for blk in block_candidates:
         if blk > _round_up(k, 512):
             continue
         try:
-            fn = functools.partial(
-                histogram_tiles_pallas_mode, num_bins=num_bins, block=blk,
-                mode=mode, interpret=interpret)
-            r = fn(subT, stats, lid, sel)
-            jnp.sum(r).block_until_ready()       # compile + first run
+            r = run_fn(blk)
+            r.block_until_ready()                # compile + first run
             t0 = time.time()
-            r = fn(subT, stats, lid, sel)
-            float(jnp.sum(r))                    # sync via scalar fetch
+            float(run_fn(blk))                   # sync via scalar fetch
             times[blk] = time.time() - t0
         except Exception as e:                   # candidate unsupported
             from ..utils import faults
@@ -528,7 +934,7 @@ def autotune_hist(binsT, num_bins: int, mode: str = "hilo",
                          f"(RESOURCE_EXHAUSTED at this shape)")
             continue
     if not times:
-        out = {"block": 0, "tile_leaves": tile}
+        out = {"block": 0, "tile_leaves": tile, "epilogue": epilogue}
     else:
         best = min(times, key=times.get)
         from ..utils import log
@@ -536,7 +942,8 @@ def autotune_hist(binsT, num_bins: int, mode: str = "hilo",
                  + ", ".join(f"blk{b_}={t * 1e3:.1f}ms"
                              for b_, t in sorted(times.items()))
                  + f" -> block={best} tile_leaves={tile} "
-                 f"(at {k} sampled rows, mode={mode})")
-        out = {"block": best, "tile_leaves": tile}
+                 f"(at {k} sampled rows, mode={mode}, "
+                 f"epilogue={epilogue})")
+        out = {"block": best, "tile_leaves": tile, "epilogue": epilogue}
     _tuned[key] = out
     return out

@@ -161,6 +161,26 @@ def threshold_l1(s: jax.Array, l1: jax.Array) -> jax.Array:
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
 
 
+def _round_fence(x: jax.Array, p: "SplitParams") -> jax.Array:
+    """Value-preserving rounding fence for the gain math (the
+    models/gbdt.py _fma_guard idiom): bitcast to the matching integer
+    width, XOR with a runtime-zero salt the compiler cannot fold, bitcast
+    back. XLA contracts a multiply feeding an add into an FMA whose
+    single rounding drifts 1 ulp — and WHICH adds it contracts depends on
+    the surrounding program, so the same gain expression compiled in two
+    places (the classic split phase vs the fused tile epilogue, or either
+    side of a compaction-rung lax.cond) can disagree in the last bit.
+    Fencing each product before it enters an add pins the two-rounding
+    sequence everywhere, which is what makes the split_fusion bit-parity
+    contract (and the classic path's own cross-context stability) hold.
+    The salt ``l2 != l2`` is zero unless lambda_l2 is NaN — runtime data
+    the simplifier cannot prove constant."""
+    itype = jnp.uint64 if x.dtype == jnp.float64 else jnp.uint32
+    salt = (p.lambda_l2 != p.lambda_l2).astype(itype)
+    xi = jax.lax.bitcast_convert_type(x, itype)
+    return jax.lax.bitcast_convert_type(jnp.bitwise_xor(xi, salt), x.dtype)
+
+
 def calculate_leaf_output(sum_g, sum_h, p: SplitParams, num_data, parent_output,
                           lambda_l2=None):
     """reference: feature_histogram.hpp:743-764 CalculateSplittedLeafOutput."""
@@ -170,15 +190,27 @@ def calculate_leaf_output(sum_g, sum_h, p: SplitParams, num_data, parent_output,
                     jnp.sign(ret) * p.max_delta_step, ret)
     use_smooth = p.path_smooth > K_EPSILON
     n_over_s = num_data / jnp.where(use_smooth, p.path_smooth, 1.0)
-    smoothed = ret * (n_over_s / (n_over_s + 1.0)) + parent_output / (n_over_s + 1.0)
+    # the product rounds concretely before the add (_round_fence): the
+    # smoothing multiply-add is FMA-contraction-prone and must compute
+    # the same bits in every compilation context (classic phase, fused
+    # epilogue, compaction-rung branches); the division term cannot
+    # contract and needs no fence
+    smoothed = (_round_fence(ret * (n_over_s / (n_over_s + 1.0)), p)
+                + parent_output / (n_over_s + 1.0))
     return jnp.where(use_smooth, smoothed, ret)
 
 
 def leaf_gain_given_output(sum_g, sum_h, output, p: SplitParams, lambda_l2=None):
-    """reference: feature_histogram.hpp:846-856 GetLeafGainGivenOutput."""
+    """reference: feature_histogram.hpp:846-856 GetLeafGainGivenOutput.
+
+    Both products pass the rounding fence before the add — see
+    _round_fence: the gain must compute the same bits wherever this
+    expression is compiled (classic split phase, fused tile epilogue,
+    either side of a compaction-rung cond)."""
     l2 = p.lambda_l2 if lambda_l2 is None else lambda_l2
     sg = threshold_l1(sum_g, p.lambda_l1)
-    return -(2.0 * sg * output + (sum_h + l2) * output * output)
+    return -(_round_fence(2.0 * sg * output, p)
+             + _round_fence((sum_h + l2) * output * output, p))
 
 
 def leaf_gain(sum_g, sum_h, p: SplitParams, num_data, parent_output, lambda_l2=None):
@@ -248,7 +280,8 @@ def _leaf_gain_nosmooth(sum_g, sum_h, p: SplitParams, lambda_l2):
     out = -sg / (sum_h + lambda_l2)
     out = jnp.where((p.max_delta_step > 0) & (jnp.abs(out) > p.max_delta_step),
                     jnp.sign(out) * p.max_delta_step, out)
-    return -(2.0 * sg * out + (sum_h + lambda_l2) * out * out)
+    return -(_round_fence(2.0 * sg * out, p)
+             + _round_fence((sum_h + lambda_l2) * out * out, p))
 
 
 def find_best_cat_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
@@ -456,6 +489,255 @@ def find_best_cat_splits(hist: jax.Array, leaf_sum_g, leaf_sum_h, leaf_cnt,
     l2_out = jnp.where(use_onehot[0, bf], p.lambda_l2, l2_sorted)
     return (best_gain.astype(jnp.float32), bf, left_g, left_h, left_c,
             words, l2_out)
+
+
+# ------------------------------------------------- fused split epilogue
+#
+# The split-finding epilogue of the fused Pallas histogram pipeline
+# (ops/pallas_hist.py): after the kernel's last grid step accumulates a
+# tile's histogram planes in VMEM, the NUMERICAL threshold scan below runs
+# in-kernel and reduces each (leaf, feature) to one best candidate — only
+# the tiny [P, F, CAND_CHANNELS] table returns to the grower's split
+# phase, never the [F, B, S] planes. The same function is the XLA twin
+# for the non-Pallas backends (models/grower.py tile_pass under
+# ``split_fusion``), so the two paths are the SAME jnp ops on the same
+# plane values — bit-identical tables by construction.
+#
+# Division of labor with find_best_splits (which stays the one place for
+# categorical / EFB-bundle / forced-split / CEGB / extra_trees semantics;
+# the grower only enables the fused path when none of those apply):
+#   in the scan (per-bin, must precede the per-feature reduction):
+#     missing-type bin exclusion, both-direction cumulative sums, gains
+#     with l1/l2/max_delta_step/path_smooth, basic-monotone clip +
+#     violation zeroing, min_data/min_hessian masks, threshold-range
+#     masks, strict gain > min_gain_shift, NaN rejection, and the
+#     reference's within-feature tie order (reverse scan first, highest
+#     threshold; forward strictly-greater, lowest threshold).
+#   deferred to candidates_to_splitinfo (whole-feature/whole-leaf
+#     multiplicative or masking transforms that cannot change the
+#     within-feature argmax): feature_contri, the monotone depth penalty,
+#     feature_mask/interaction masks, the max_depth gate, and the
+#     cross-feature lowest-index-wins argmax — applied in exactly the
+#     order find_best_splits applies them, so a fused and a classic run
+#     pick the same candidate with the same stored gain bits.
+
+# candidate-table channel layout ([..., CAND_CHANNELS] float32): gain is
+# the SHIFTED raw gain (gain - min_gain_shift; K_MIN_SCORE = invalid),
+# threshold/is_rev stored as exact small-integer floats. 12 channels (10
+# used + 2 pad) keep the per-leaf table at exactly 1/(B/4) of the
+# [F, B, 3] plane bytes the classic search streams — the ISSUE 12
+# acceptance floor, asserted from the REAL returned buffers in
+# kernel_bench and the fusion tests
+CAND_CHANNELS = 12
+CAND_GAIN, CAND_THR, CAND_REV = 0, 1, 2
+CAND_LG, CAND_LH, CAND_LC = 3, 4, 5
+CAND_RG, CAND_RH, CAND_RC = 6, 7, 8
+
+
+def numerical_candidates(hist, leaf_sum_g, leaf_sum_h, leaf_cnt, leaf_output,
+                         num_bins_f, missing_type_f, default_bin_f,
+                         monotone_f, p: SplitParams, *,
+                         with_monotone: bool = False,
+                         leaf_min=None, leaf_max=None) -> jax.Array:
+    """Per-(leaf, feature) best numerical split candidate.
+
+    The kernel-callable core of find_best_splits' numerical scan (same
+    ops in the same order — the fused-vs-classic bit-parity suite pins
+    the agreement): evaluates every (direction, threshold) with the full
+    validity mask set and reduces each feature to its best candidate
+    under the reference's within-feature tie order.
+
+    Args:
+      hist: [P, F, B, 3] float32 histogram planes (excluded bins NOT yet
+        zeroed — done here, like find_best_splits).
+      leaf_sum_g/h/cnt/output: [P] leaf aggregates for the tile's slots.
+      num_bins_f/missing_type_f/default_bin_f/monotone_f: [F] int32 (the
+        FeatureMeta columns, passed as bare arrays so the Pallas kernel
+        can load them from a packed f32 input).
+      p: SplitParams (only the 7 numerical-scan fields are read, so the
+        kernel can rebuild it from a scalar vector).
+      with_monotone: static; basic-mode [P] output bounds.
+
+    Returns:
+      [P, F, CAND_CHANNELS] float32 candidate table (see CAND_*).
+    """
+    P, F, B, _ = hist.shape
+    nb = num_bins_f[None, :, None]
+    bins = jnp.arange(B, dtype=jnp.int32)[None, None, :]
+
+    mode_a = (num_bins_f > 2) & (missing_type_f != MISSING_NONE)
+    is_nan = missing_type_f == MISSING_NAN
+    is_zero = missing_type_f == MISSING_ZERO
+
+    excl = jnp.zeros((1, F, B), dtype=bool)
+    excl = excl | (mode_a & is_nan)[None, :, None] & (bins == nb - 1)
+    excl = excl | ((mode_a & is_zero)[None, :, None]
+                   & (bins == default_bin_f[None, :, None]))
+    hist_excl = jnp.where(excl[:, :, :, None], 0.0, hist)
+
+    s = _directional_sums(hist_excl, leaf_sum_g, leaf_sum_h, leaf_cnt)
+    parent_out = leaf_output[:, None, None]
+
+    def clip_out(out):
+        if not with_monotone:
+            return out
+        return jnp.clip(out, leaf_min[:, None, None], leaf_max[:, None, None])
+
+    def split_gain_dir(prefix):
+        lg, lh, lc = (s[f"{prefix}_left_g"], s[f"{prefix}_left_h"],
+                      s[f"{prefix}_left_c"])
+        rg, rh, rc = (s[f"{prefix}_right_g"], s[f"{prefix}_right_h"],
+                      s[f"{prefix}_right_c"])
+        lo = clip_out(calculate_leaf_output(lg, lh, p, lc, parent_out))
+        ro = clip_out(calculate_leaf_output(rg, rh, p, rc, parent_out))
+        gain = (leaf_gain_given_output(lg, lh, lo, p)
+                + leaf_gain_given_output(rg, rh, ro, p))
+        if with_monotone:
+            mono = monotone_f[None, :, None]
+            viol = (((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro)))
+            gain = jnp.where(viol, 0.0, gain)
+        return gain
+
+    gain_fwd = split_gain_dir("fwd")
+    gain_rev = split_gain_dir("rev")
+
+    min_gain_shift = (leaf_gain(leaf_sum_g, leaf_sum_h, p, leaf_cnt,
+                                leaf_output)
+                      + p.min_gain_to_split)[:, None, None]
+
+    def constraint_mask(prefix):
+        lh, lc = s[f"{prefix}_left_h"], s[f"{prefix}_left_c"]
+        rh, rc = s[f"{prefix}_right_h"], s[f"{prefix}_right_c"]
+        return ((lc >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
+                & (lh >= p.min_sum_hessian_in_leaf)
+                & (rh >= p.min_sum_hessian_in_leaf))
+
+    thr_ok_common = bins <= nb - 2
+    fwd_ok = mode_a[None, :, None] & thr_ok_common
+    rev_upper = nb - 2 - (mode_a & is_nan)[None, :, None].astype(jnp.int32)
+    rev_ok = bins <= rev_upper
+    zero_thr_skip = ((mode_a & is_zero)[None, :, None]
+                     & (bins == default_bin_f[None, :, None]))
+    fwd_ok = fwd_ok & ~zero_thr_skip
+    rev_ok = rev_ok & ~zero_thr_skip
+
+    valid_fwd = (constraint_mask("fwd") & fwd_ok
+                 & (gain_fwd > min_gain_shift) & ~jnp.isnan(gain_fwd))
+    valid_rev = (constraint_mask("rev") & rev_ok
+                 & (gain_rev > min_gain_shift) & ~jnp.isnan(gain_rev))
+
+    key_fwd = jnp.where(valid_fwd, gain_fwd - min_gain_shift, K_MIN_SCORE)
+    key_rev = jnp.where(valid_rev, gain_rev - min_gain_shift, K_MIN_SCORE)
+
+    # within-feature lexicographic reduction (the reference's scan order:
+    # reverse runs first and keeps the highest-threshold maximum, forward
+    # replaces only on strictly greater gain, lowest threshold first) —
+    # the [2, B] preference values match find_best_splits' tpref exactly
+    gains = jnp.stack([key_rev, key_fwd], axis=2)            # [P, F, 2, B]
+    pref = jnp.stack([2 * B + bins, (B - 1) - bins],
+                     axis=2)                                  # [1, 1, 2, B]
+    flat = gains.reshape(P, F, 2 * B)
+    best = jnp.max(flat, axis=2)
+    is_best = flat == best[..., None]
+    pref_b = jnp.broadcast_to(pref, gains.shape).reshape(P, F, 2 * B)
+    bidx = jnp.argmax(jnp.where(is_best, pref_b, -1), axis=2)
+    bdir = (bidx // B).astype(jnp.int32)                     # 0=rev, 1=fwd
+    bt = (bidx % B).astype(jnp.int32)
+
+    def pick(rev_name, fwd_name):
+        rv = jnp.take_along_axis(s[rev_name], bt[:, :, None], axis=2)[..., 0]
+        fv = jnp.take_along_axis(s[fwd_name], bt[:, :, None], axis=2)[..., 0]
+        return jnp.where(bdir == 0, rv, fv)
+
+    out = jnp.zeros((P, F, CAND_CHANNELS), jnp.float32)
+    out = out.at[:, :, CAND_GAIN].set(best.astype(jnp.float32))
+    out = out.at[:, :, CAND_THR].set(bt.astype(jnp.float32))
+    out = out.at[:, :, CAND_REV].set((bdir == 0).astype(jnp.float32))
+    out = out.at[:, :, CAND_LG].set(pick("rev_left_g", "fwd_left_g"))
+    out = out.at[:, :, CAND_LH].set(pick("rev_left_h", "fwd_left_h"))
+    out = out.at[:, :, CAND_LC].set(pick("rev_left_c", "fwd_left_c"))
+    out = out.at[:, :, CAND_RG].set(pick("rev_right_g", "fwd_right_g"))
+    out = out.at[:, :, CAND_RH].set(pick("rev_right_h", "fwd_right_h"))
+    out = out.at[:, :, CAND_RC].set(pick("rev_right_c", "fwd_right_c"))
+    return out
+
+
+def candidates_to_splitinfo(cand, leaf_sum_g, leaf_sum_h, leaf_cnt,
+                            leaf_output, leaf_depth, meta: FeatureMeta,
+                            p: SplitParams, feature_mask, max_depth: int = -1,
+                            cat_words: int = CAT_BITSET_WORDS,
+                            with_monotone: bool = False,
+                            leaf_min=None, leaf_max=None) -> SplitInfo:
+    """Cross-feature argmax over a candidate table -> per-leaf SplitInfo.
+
+    Applies the transforms find_best_splits folds into its keyed gains —
+    feature_contri, the monotone depth penalty, feature/depth masking —
+    in the same order, then the cross-feature lowest-index-wins argmax
+    (the reference's in-order feature loop with strict operator>). The
+    candidates' within-feature selection already happened in the scan, so
+    only whole-feature transforms that COMMUTE with it are legal here:
+    the contri multiplier commutes only when positive (the reference
+    itself applies penalty post-scan, feature_histogram.hpp:94, but
+    find_best_splits applies it per bin — the gbdt resolver keeps
+    non-positive feature_contri on the classic phase), and the monotone
+    depth penalty is floored at K_EPSILON > 0. The fused-vs-classic
+    bit-parity suite pins the equivalence.
+
+    Args:
+      cand: [P, F, CAND_CHANNELS] from numerical_candidates.
+      feature_mask: [P, F] bool/float validity.
+    """
+    P, F, _ = cand.shape
+    raw = cand[:, :, CAND_GAIN]
+    valid = jnp.isfinite(raw)
+    contri = meta.penalty[None, :]
+    mono_pen = monotone_split_penalty(leaf_depth, p)[:, None]
+    is_mono = (meta.monotone != 0)[None, :]
+    key = raw * contri
+    key = jnp.where(is_mono, key * mono_pen, key)
+
+    fmask = feature_mask.astype(bool) & ~meta.is_categorical[None, :]
+    depth_ok = (jnp.ones((P,), bool) if max_depth <= 0
+                else (leaf_depth < max_depth))
+    key = jnp.where(valid & fmask & depth_ok[:, None], key, K_MIN_SCORE)
+
+    best_gain = jnp.max(key, axis=1)
+    is_best = key == best_gain[:, None]
+    fpref = (F - 1) - jnp.arange(F, dtype=jnp.int32)[None, :]
+    bf = jnp.argmax(jnp.where(is_best, fpref, -1), axis=1).astype(jnp.int32)
+
+    li = jnp.arange(P)
+    row = cand[li, bf]                                       # [P, CAND]
+    bt = row[:, CAND_THR].astype(jnp.int32)
+    bdir_rev = row[:, CAND_REV] > 0.5
+    left_g, left_h, left_c = row[:, CAND_LG], row[:, CAND_LH], row[:, CAND_LC]
+    right_g, right_h, right_c = (row[:, CAND_RG], row[:, CAND_RH],
+                                 row[:, CAND_RC])
+
+    left_out = calculate_leaf_output(left_g, left_h, p, left_c, leaf_output)
+    right_out = calculate_leaf_output(right_g, right_h, p, right_c,
+                                      leaf_output)
+    if with_monotone:
+        left_out = jnp.clip(left_out, leaf_min, leaf_max)
+        right_out = jnp.clip(right_out, leaf_min, leaf_max)
+
+    mode_a = (meta.num_bins > 2) & (meta.missing_type != MISSING_NONE)
+    nan_single = ((meta.missing_type == MISSING_NAN) & ~mode_a)[bf]
+    default_left = bdir_rev & ~nan_single
+
+    return SplitInfo(
+        gain=best_gain.astype(jnp.float32),
+        feature=bf,
+        threshold=bt,
+        default_left=default_left,
+        left_sum_g=left_g, left_sum_h=left_h, left_count=left_c,
+        right_sum_g=right_g, right_sum_h=right_h, right_count=right_c,
+        left_output=left_out, right_output=right_out,
+        is_cat=jnp.zeros((P,), dtype=bool),
+        cat_bitset=jnp.zeros((P, cat_words), dtype=jnp.uint32),
+        seg_lo=jnp.full((P,), -1, jnp.int32),
+        seg_hi=jnp.full((P,), -1, jnp.int32),
+    )
 
 
 def monotone_split_penalty(leaf_depth, p: SplitParams):

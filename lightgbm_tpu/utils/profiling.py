@@ -63,6 +63,16 @@ def counters() -> Dict[str, float]:
     return dict(_counters)
 
 
+def scopes() -> Dict[str, Dict[str, float]]:
+    """Accumulated timer scopes as data: ``{name: {"total_s", "calls",
+    "mean_ms"}}`` — what ``table()`` prints, machine-readable (bench.py's
+    phase sub-scope probe reads hist_pass / split_search / apply_split
+    out of this for the BENCH JSON ``phases`` dict)."""
+    return {name: {"total_s": _acc[name], "calls": _cnt[name],
+                   "mean_ms": 1e3 * _acc[name] / max(_cnt[name], 1)}
+            for name in _acc}
+
+
 # Health gauges: last-value-wins instruments (heartbeat age, supervisor
 # restart count, per-rank last iteration) — unlike the timers/counters
 # these are ALWAYS on (a restart count that only records under TIMETAG

@@ -392,22 +392,34 @@ def test_autotune_hook():
     """autotune_hist: a no-op off-TPU (no timing, defaults returned);
     force_measure runs the interpreter candidates, returns a candidate
     block + the structural 128-lane leaf batch, and caches per shape
-    bucket."""
+    bucket — KEYED on the epilogue flag (ISSUE 12: a block tuned for the
+    plane-returning kernel must never replay into the epilogue kernel)."""
     rng = np.random.RandomState(8)
     binsT = jnp.asarray(rng.randint(0, 16, size=(3, 600)).astype(np.int8))
     if jax.default_backend() != "tpu":
         assert pallas_hist.autotune_hist(binsT, 16) == \
-            {"block": 0, "tile_leaves": 0}
+            {"block": 0, "tile_leaves": 0, "epilogue": False}
     tuned = pallas_hist.autotune_hist(binsT, 16, mode="hilo",
                                       block_candidates=(512, 1024),
                                       force_measure=True)
     assert tuned["tile_leaves"] == 42                 # 128 // 3
     assert tuned["block"] in (0, 512, 1024)
-    key = (3, 16, 600 .bit_length(), "hilo")
+    assert tuned["epilogue"] is False
+    key = (3, 16, 600 .bit_length(), "hilo", False)
     assert pallas_hist._tuned[key] == tuned
     # cache hit: identical dict back without re-measuring
     assert pallas_hist.autotune_hist(binsT, 16, mode="hilo",
                                      force_measure=True) == tuned
+    # the epilogue form sweeps and caches under its OWN key: the two
+    # kernel forms never share a tuned block
+    tuned_epi = pallas_hist.autotune_hist(binsT, 16, mode="hilo",
+                                          block_candidates=(512,),
+                                          force_measure=True,
+                                          epilogue=True)
+    assert tuned_epi["epilogue"] is True
+    key_epi = (3, 16, 600 .bit_length(), "hilo", True)
+    assert pallas_hist._tuned[key_epi] == tuned_epi
+    assert key != key_epi and pallas_hist._tuned[key] == tuned
 
 
 @pytest.mark.skipif(jax.default_backend() != "tpu",

@@ -1,0 +1,450 @@
+"""Fused split-finding epilogue + level-batched frontier growth (ISSUE 12).
+
+The fusion contract under test, at three levels:
+
+- UNIT: numerical_candidates + candidates_to_splitinfo reproduce
+  find_best_splits bit-for-bit on the numerical non-bundled search, and
+  the Pallas epilogue kernel (interpret) matches the XLA twin bit-for-bit
+  — including in-pass sibling derivation (parent - computed), exact on
+  representable sums.
+- E2E: split_fusion=on model text is BIT-IDENTICAL to split_fusion=off
+  across the split-semantics edge-config matrix (monotone, missing both
+  directions, min_data/min_hessian, l1/path-smooth/max-delta, subset
+  bagging, interactions, exact mode, q8), on both the XLA twin (scatter)
+  and the in-kernel path (pallas interpret).
+- GATING: "auto" falls back to the classic phase for the configurations
+  whose semantics stay in find_best_splits (categorical, EFB, forced
+  splits, CEGB, extra_trees) — still training correctly — while "on"
+  refuses them loudly; the autotune trainer-state ride keys on the
+  epilogue flag; the phased grower is bit-identical and launches one
+  histogram pass per frontier LEVEL, not per leaf.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.binning import MISSING_NONE
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.ops import pallas_hist
+from lightgbm_tpu.ops.histogram import (histogram_tiles,
+                                        histogram_tiles_with_candidates)
+from lightgbm_tpu.ops.split import (CAND_CHANNELS, FeatureMeta, SplitParams,
+                                    candidates_to_splitinfo,
+                                    find_best_splits, numerical_candidates)
+
+pytestmark = pytest.mark.pallas
+
+
+# ------------------------------------------------------------------- unit
+
+def _rand_hist(rng, L, F, B):
+    h = rng.rand(L, F, B, 3).astype(np.float32)
+    h[..., 2] = rng.randint(0, 50, size=(L, F, B)).astype(np.float32)
+    h[..., 1] = np.abs(h[..., 1]) * h[..., 2]
+    return jnp.asarray(h)
+
+
+def _meta(F, B, missing=MISSING_NONE, monotone=None):
+    from lightgbm_tpu.binning import MISSING_NAN, MISSING_ZERO
+    mt = {"none": MISSING_NONE, "nan": MISSING_NAN,
+          "zero": MISSING_ZERO}[missing] if isinstance(missing, str) \
+        else missing
+    return FeatureMeta(
+        num_bins=jnp.full((F,), B, jnp.int32),
+        missing_type=jnp.full((F,), mt, jnp.int32),
+        default_bin=jnp.full((F,), 1, jnp.int32),
+        is_categorical=jnp.zeros((F,), bool),
+        monotone=(jnp.zeros((F,), jnp.int8) if monotone is None
+                  else jnp.asarray(monotone, jnp.int8)),
+        penalty=jnp.ones((F,), jnp.float32))
+
+
+@pytest.mark.parametrize("missing", ["none", "nan", "zero"])
+@pytest.mark.parametrize("mono", [None, [1, -1, 0, 1]])
+def test_candidates_match_find_best_splits(missing, mono):
+    """The shared scan + table consumer == find_best_splits, field by
+    field, bit for bit — the factored code paths cannot drift."""
+    rng = np.random.RandomState(3)
+    L, F, B = 6, 4, 17
+    hist = _rand_hist(rng, L, F, B)
+    sum_g = jnp.asarray(hist[:, 0, :, 0].sum(axis=1))
+    sum_h = jnp.asarray(hist[:, 0, :, 1].sum(axis=1))
+    cnt = jnp.asarray(hist[:, 0, :, 2].sum(axis=1))
+    out = jnp.asarray(rng.randn(L).astype(np.float32) * 0.1)
+    depth = jnp.asarray(rng.randint(0, 3, L).astype(np.int32))
+    meta = _meta(F, B, missing, mono)
+    p = SplitParams.from_config(Config.from_params(
+        {"min_data_in_leaf": 5, "min_sum_hessian_in_leaf": 1e-3,
+         "lambda_l1": 0.1, "lambda_l2": 0.3, "path_smooth": 1.5,
+         "max_delta_step": 0.8}))
+    with_mono = mono is not None
+    lmin = (jnp.full((L,), -0.5) if with_mono else None)
+    lmax = (jnp.full((L,), 0.5) if with_mono else None)
+    fmask = jnp.ones((L, F), jnp.float32)
+
+    ref = find_best_splits(hist, sum_g, sum_h, cnt, out, depth, meta, p,
+                           fmask, max_depth=4,
+                           leaf_min=lmin, leaf_max=lmax)
+    cand = numerical_candidates(
+        hist, sum_g, sum_h, cnt, out, meta.num_bins, meta.missing_type,
+        meta.default_bin, meta.monotone.astype(jnp.int32), p,
+        with_monotone=with_mono, leaf_min=lmin, leaf_max=lmax)
+    assert cand.shape == (L, F, CAND_CHANNELS)
+    got = candidates_to_splitinfo(
+        cand, sum_g, sum_h, cnt, out, depth, meta, p, fmask, max_depth=4,
+        with_monotone=with_mono, leaf_min=lmin, leaf_max=lmax)
+    for name in ("gain", "feature", "threshold", "default_left",
+                 "left_sum_g", "left_sum_h", "left_count", "right_sum_g",
+                 "right_sum_h", "right_count", "left_output",
+                 "right_output"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)), np.asarray(getattr(ref, name)),
+            err_msg=name)
+
+
+def _epi_inputs(n=3001, f=5, b=63, seed=0, int8=False):
+    """Representable (or int8) stats with a POSITIVE hessian channel —
+    real training stats, so every leaf has valid split candidates."""
+    rng = np.random.RandomState(seed)
+    binsT = rng.randint(0, b, size=(f, n)).astype(np.uint8)
+    if int8:
+        stats = rng.randint(-127, 128, size=(n, 3)).astype(np.int8)
+        stats[:, 1] = rng.randint(1, 128, size=n)
+        stats[:, 2] = 1
+    else:
+        stats = (rng.randint(-1023, 1024, size=(n, 3)) / 1024.0
+                 ).astype(np.float32)
+        stats[:, 1] = rng.randint(1, 1024, size=n) / 1024.0
+        stats[:, 2] = 1.0
+    leaf = rng.randint(0, 3, n).astype(np.int32)
+    return binsT, np.ascontiguousarray(binsT.T), stats, leaf
+
+
+@pytest.mark.parametrize("mode,method,with_mono", [
+    ("highest", "pallas", False),
+    ("highest", "pallas", True),
+    ("q8", "pallas_q8", False)])
+def test_epilogue_kernel_matches_xla_twin_and_derives_exactly(mode, method,
+                                                              with_mono):
+    """The in-kernel epilogue == the XLA twin bit-for-bit on representable
+    sums, AND the derived sibling's plane (parent - computed, the static
+    lane shift) equals its directly-built histogram exactly."""
+    n, f, b = 3001, 5, 63
+    binsT, bins, stats, leaf = _epi_inputs(int8=(mode == "q8"))
+    jb, jbT = jnp.asarray(bins), jnp.asarray(binsT)
+    jst, jl = jnp.asarray(stats), jnp.asarray(leaf)
+    # pair: leaf 0 computed at slot 0, leaf 1 derived at slot 1; leaf 2
+    # computed alone at slot 2
+    sel = jnp.asarray(np.array([0, 1, 2, -1, -1, -1], np.int32))
+    derive = jnp.asarray(np.array([0, 1, 0, 0, 0, 0], bool))
+    # the parent's plane (leaves 0+1 merged) — f32, as resident in the
+    # grower's state after dequantization
+    parent_leaf = jnp.asarray(np.where(np.isin(leaf, [0, 1]), 0, 2)
+                              .astype(np.int32))
+    st_f = jnp.asarray(stats.astype(np.float32))
+    hp = histogram_tiles(jb, st_f, parent_leaf, jnp.asarray([0], jnp.int32),
+                         b, method="scatter")
+    parent = jnp.zeros((6, f, b, 3), jnp.float32).at[1].set(hp[0])
+
+    sums = np.zeros((6, 3), np.float32)
+    for p_i, lv in enumerate([0, 1, 2]):
+        sums[p_i] = stats[leaf == lv].astype(np.float64).sum(0)
+    la = pallas_hist.pack_leaf_aux(
+        *(jnp.asarray(sums[:, i]) for i in range(3)), jnp.zeros((6,)),
+        leaf_min=jnp.full((6,), -0.4) if with_mono else None,
+        leaf_max=jnp.full((6,), 0.4) if with_mono else None)
+    fmeta = pallas_hist.pack_feature_meta(
+        jnp.full((f,), b, jnp.int32), jnp.zeros((f,), jnp.int32),
+        jnp.zeros((f,), jnp.int32),
+        (jnp.asarray([1, -1, 0, 1, -1], jnp.int32) if with_mono
+         else jnp.zeros((f,), jnp.int32)))
+    pvec = pallas_hist.pack_scan_params(
+        SplitParams.from_config(Config.from_params({})))
+    qsc = jnp.ones((3,), jnp.float32) if mode == "q8" else None
+
+    # both arms jitted: the grower always runs them inside one compiled
+    # program, and eager-vs-jit would differ in FMA contraction, not in
+    # the math under test
+    kw = dict(num_bins=b, block=512, with_monotone=with_mono, q_scale=qsc)
+    run_k = jax.jit(lambda *a: histogram_tiles_with_candidates(
+        *a, method=method, binsT=jbT, interpret=True, **kw))
+    xla_m = "onehot_q8" if mode == "q8" else "scatter"
+    run_x = jax.jit(lambda *a: histogram_tiles_with_candidates(
+        *a, method=xla_m, binsT=jbT, **kw))
+    tile_k, cand_k = run_k(jb, jst, jl, sel, derive, parent, la, fmeta,
+                           pvec)
+    tile_x, cand_x = run_x(jb, jst, jl, sel, derive, parent, la, fmeta,
+                           pvec)
+    np.testing.assert_array_equal(np.asarray(tile_k), np.asarray(tile_x))
+    np.testing.assert_array_equal(np.asarray(cand_k), np.asarray(cand_x))
+    # sibling-derivation exactness: the derived plane == leaf 1's
+    # directly-built histogram (representable/integer sums -> exact
+    # subtraction)
+    direct = histogram_tiles(jb, st_f, jl, jnp.asarray([1], jnp.int32), b,
+                             method="scatter")
+    np.testing.assert_array_equal(np.asarray(tile_k[1]),
+                                  np.asarray(direct[0]))
+    # and the candidate table for the derived slot is populated
+    assert np.isfinite(np.asarray(cand_k)[1, :, 0]).any()
+    # acceptance floor from the REAL buffers: per-leaf plane bytes the
+    # classic search streams vs the candidate row the fused search reads
+    plane_per_leaf = tile_k.nbytes / tile_k.shape[0]
+    cand_per_leaf = cand_k.nbytes / cand_k.shape[0]
+    assert plane_per_leaf / cand_per_leaf >= b / 4, (
+        plane_per_leaf, cand_per_leaf, b)
+
+
+def test_search_bytes_floor():
+    """Acceptance: split-search consumer bytes reduced >= B/4x — per-leaf
+    [F, B, 4] planes vs the [F, CAND_CHANNELS] candidate row."""
+    for b in (63, 255):
+        t = pallas_hist.traffic_model(500_000, 28, b, 42, 3)
+        ratio = t["search_in_planes"] / t["search_in_cand"]
+        assert ratio >= b / 4, (b, ratio)
+
+
+# ------------------------------------------------------------------- e2e
+
+def _tree_text(booster):
+    return "\n".join(l for l in booster.model_to_string().splitlines()
+                     if not l.startswith("[") and l != "end of parameters")
+
+
+def _data(seed=4, n=1400, f=5, with_nan=False, with_zero=False):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    if with_zero:
+        X[rng.rand(n, f) < 0.3] = 0.0
+    y = (2.0 * (X[:, 0] > 0.3) + 1.0 * (X[:, 1] > -0.2)
+         + 0.5 * (X[:, 2] > 0.5) + 0.01 * rng.normal(size=n))
+    if with_nan:
+        X[rng.rand(n, f) < 0.15] = np.nan
+    return X, y
+
+
+def _train_text(X, y, params, rounds=3):
+    # fused_iteration off: the parity under test lives in the GROWER, and
+    # the unfused path dispatches the module-level grow_tree jit — its
+    # cache is shared across every config in this file that maps to the
+    # same statics, so the matrix costs compiles only where the statics
+    # actually differ (the fused-step program is per-booster and would
+    # recompile for every single cell)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1, **{
+        k: params[k] for k in ("max_bin", "zero_as_missing")
+        if k in params}})
+    booster = lgb.train({"objective": "regression", "num_leaves": 8,
+                         "verbosity": -1, "fused_iteration": False,
+                         **params}, ds, num_boost_round=rounds)
+    return _tree_text(booster)
+
+
+EDGE_CONFIGS = [
+    pytest.param({}, {}, id="default"),
+    pytest.param({"monotone_constraints": [1, -1, 0, 0, 0]}, {},
+                 id="monotone-basic"),
+    pytest.param({}, {"with_nan": True}, id="missing-nan"),
+    pytest.param({"zero_as_missing": True}, {"with_zero": True},
+                 id="missing-zero"),
+    pytest.param({"min_data_in_leaf": 60,
+                  "min_sum_hessian_in_leaf": 5.0}, {}, id="min-data-hess"),
+    pytest.param({"lambda_l1": 0.5, "lambda_l2": 1.3, "path_smooth": 2.0,
+                  "max_delta_step": 0.3}, {}, id="l1-smooth-delta"),
+    pytest.param({"max_depth": 3}, {}, id="max-depth"),
+    pytest.param({"tree_growth_mode": "exact"}, {}, id="exact"),
+    pytest.param({"bagging_fraction": 0.4, "bagging_freq": 1}, {},
+                 id="subset-bagging"),
+    pytest.param({"feature_fraction": 0.6}, {}, id="col-sampling"),
+    pytest.param({"interaction_constraints": [[0, 1], [2, 3, 4]]}, {},
+                 id="interactions"),
+    pytest.param({"quantized_grad": True}, {}, id="q8"),
+]
+
+
+@pytest.mark.parametrize("params,dkw", EDGE_CONFIGS)
+def test_e2e_fusion_bit_parity_xla(params, dkw):
+    """split_fusion on == off, model text bit-identical, on the XLA twin
+    (scatter backend) across the split-semantics edge-config matrix."""
+    X, y = _data(**dkw)
+    base = {"histogram_method": "scatter", **params}
+    t_on = _train_text(X, y, {**base, "split_fusion": "on"})
+    t_off = _train_text(X, y, {**base, "split_fusion": "off"})
+    assert t_on == t_off
+
+
+@pytest.mark.parametrize("params,dkw", [
+    pytest.param({}, {}, id="default"),
+    pytest.param({"quantized_grad": True}, {}, id="q8"),
+])
+def test_e2e_fusion_bit_parity_kernel(params, dkw):
+    """split_fusion on == off through the IN-KERNEL epilogue (pallas
+    interpret, compaction ladder on so the gather-epilogue kernel runs
+    inside the rung dispatch). The missing-direction/monotone/etc edge
+    matrix is covered bit-for-bit on the XLA twin above — the kernel
+    runs the SAME scan function, and its plane assembly + monotone aux
+    are pinned by the kernel-vs-twin unit test — so this matrix only
+    needs the configs that change the KERNEL's own launch shape (the
+    default pass and q8's in-kernel dequant)."""
+    X, y = _data(**dkw)
+    base = {"histogram_method": "pallas", "hist_pallas_interpret": True,
+            **params}
+    t_on = _train_text(X, y, {**base, "split_fusion": "on"}, rounds=2)
+    t_off = _train_text(X, y, {**base, "split_fusion": "off"}, rounds=2)
+    assert t_on == t_off
+
+
+def test_degenerate_shapes():
+    """All-leaves-dead (root fails the 2x min_data guard -> splitless
+    tree) and the single-pending-leaf launch shape (num_leaves=2) — both
+    fused == classic."""
+    X, y = _data(n=600)
+    dead = {"histogram_method": "scatter", "min_data_in_leaf": 2000}
+    t_on = _train_text(X, y, {**dead, "split_fusion": "on"}, rounds=2)
+    t_off = _train_text(X, y, {**dead, "split_fusion": "off"}, rounds=2)
+    assert t_on == t_off
+    assert "num_leaves=1" in t_on
+    two = {"histogram_method": "scatter", "num_leaves": 2}
+    t_on = _train_text(X, y, {**two, "split_fusion": "on",
+                              "num_leaves": 2}, rounds=2)
+    t_off = _train_text(X, y, {**two, "split_fusion": "off",
+                               "num_leaves": 2}, rounds=2)
+    assert t_on == t_off
+
+
+# ---------------------------------------------------------------- gating
+
+def _cat_data(seed=5, n=1200):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, 4))
+    X[:, 3] = rng.randint(0, 6, n)
+    y = (X[:, 0] > 0) * 1.0 + (X[:, 3] == 2) * 2.0
+    return X, y
+
+
+def test_auto_falls_back_and_on_refuses():
+    """The configurations whose split semantics stay in find_best_splits:
+    'auto' silently keeps the classic phase (training equals explicit
+    'off'), 'on' raises naming the blocker."""
+    X, y = _cat_data()
+
+    def train(params, sf):
+        ds = lgb.Dataset(X, label=y, params={"verbosity": -1},
+                         categorical_feature=[3])
+        return lgb.train({"objective": "regression", "num_leaves": 8,
+                          "verbosity": -1, "split_fusion": sf,
+                          "fused_iteration": False, **params},
+                         ds, num_boost_round=2)
+
+    t_auto = _tree_text(train({}, "auto"))
+    t_off = _tree_text(train({}, "off"))
+    assert t_auto == t_off
+    with pytest.raises(ValueError, match="split_fusion=on is unsupported"):
+        train({}, "on").model_to_string()
+
+    # extra_trees / CEGB / non-positive feature_contri blockers,
+    # numerical data (the contri multiplier only commutes with the
+    # fused per-feature argmax when positive — see
+    # candidates_to_splitinfo)
+    Xn, yn = _data()
+    for blocker in ({"extra_trees": True},
+                    {"cegb_tradeoff": 0.5, "cegb_penalty_split": 0.1},
+                    {"feature_contri": [1.0, 0.0, 1.0, 1.0, 1.0]}):
+        ds = lgb.Dataset(Xn, label=yn, params={"verbosity": -1})
+        with pytest.raises(ValueError,
+                           match="split_fusion=on is unsupported"):
+            lgb.train({"objective": "regression", "verbosity": -1,
+                       "split_fusion": "on", **blocker}, ds,
+                      num_boost_round=1)
+    # and 'auto' with a non-positive contri entry falls back to the
+    # classic phase (same trees as explicit off)
+    contri = {"feature_contri": [1.0, -0.5, 1.0, 1.0, 1.0],
+              "histogram_method": "scatter"}
+    t_auto = _train_text(Xn, yn, {**contri, "split_fusion": "auto"},
+                         rounds=2)
+    t_off2 = _train_text(Xn, yn, {**contri, "split_fusion": "off"},
+                         rounds=2)
+    assert t_auto == t_off2
+
+
+def test_hist_tuned_ride_keys_on_epilogue():
+    """The autotune trainer-state ride: a ``_hist_tuned`` dict from a
+    pre-fusion checkpoint (no epilogue key) must NOT replay its block
+    into the epilogue kernel — _hist_tuning discards and re-tunes; a
+    matching-flag dict rides through untouched."""
+    X, y = _data(n=600)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    ds.construct()
+    booster = lgb.Booster(params={"objective": "regression",
+                                  "verbosity": -1}, train_set=ds)
+    gb = booster._boosting
+    # pre-fusion checkpoint ride: tuned for the plane-returning kernel
+    gb._hist_tuned = {"block": 4096, "tile_leaves": 42}
+    tile, blk = gb._hist_tuning("pallas_hilo", epilogue=True)
+    assert blk != 4096, "pre-fusion block replayed into the epilogue kernel"
+    assert gb._hist_tuned.get("epilogue") is True
+    # matching flag: the ride is honored
+    gb._hist_tuned = {"block": 2048, "tile_leaves": 42, "epilogue": False}
+    tile, blk = gb._hist_tuning("pallas_hilo", epilogue=False)
+    assert (tile, blk) == (42, 2048)
+
+
+# ------------------------------------------------------- phased profiling
+
+def test_phased_grower_bit_parity_and_frontier_launches():
+    """TIMETAG profiling routes growth through the host-phased grower:
+    bit-identical model text, hist_pass/split_search/apply_split scopes
+    recorded, and the dispatch-count regression — histogram launches per
+    tree track frontier LEVELS (well under one per leaf/split)."""
+    from lightgbm_tpu.utils import profiling
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 16,
+              "verbosity": -1, "histogram_method": "scatter",
+              "fused_iteration": False}
+    rounds = 2
+
+    def run(profile):
+        profiling.reset()
+        profiling.enable(profile)
+        try:
+            ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+            booster = lgb.train(params, ds, num_boost_round=rounds)
+            return _tree_text(booster), profiling.scopes()
+        finally:
+            profiling.enable(False)
+            profiling.reset()
+
+    t_plain, _ = run(False)
+    t_phased, scopes = run(True)
+    assert t_phased == t_plain
+    for name in ("hist_pass", "split_search", "apply_split"):
+        assert scopes.get(name, {}).get("calls", 0) > 0, (name, scopes)
+    # one histogram launch per frontier level: far fewer than one per
+    # split (15 splits/tree at 16 leaves)
+    hist_launches_per_tree = scopes["hist_pass"]["calls"] / rounds
+    assert hist_launches_per_tree < 15, scopes["hist_pass"]
+    assert hist_launches_per_tree >= 1
+
+
+def test_phased_equals_monolithic_under_fusion():
+    """Phased + split_fusion: same trees as the monolithic fused grower
+    (the phased programs run the same _grower_fns phases)."""
+    from lightgbm_tpu.utils import profiling
+    X, y = _data(n=900)
+    params = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+              "histogram_method": "scatter", "split_fusion": "on",
+              "fused_iteration": False}
+
+    def run(profile):
+        profiling.reset()
+        profiling.enable(profile)
+        try:
+            ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+            return _tree_text(lgb.train(params, ds, num_boost_round=2))
+        finally:
+            profiling.enable(False)
+            profiling.reset()
+
+    assert run(True) == run(False)
