@@ -125,23 +125,23 @@ def _warm_child(cfg):
         "warm_cache_misses": _compile_totals()["misses"]}))
 
 
-def _health_json():
-    """Supervision/health telemetry for the result JSON (restart count,
-    heartbeat table when supervised, health gauges)."""
+def _telemetry_json():
+    """The unified telemetry snapshot for the result JSON
+    (telemetry.snapshot(): scopes + counters + gauges + dispatch +
+    health in ONE versioned schema — replaces the hand-rolled
+    health/gauges spellings this file used to assemble)."""
     try:
-        from lightgbm_tpu import distributed
-        from lightgbm_tpu.utils import profiling
-        out = distributed.health_snapshot()
-        g = profiling.gauges()
-        if g:
-            out["gauges"] = {k: round(v, 3) for k, v in g.items()}
-        return out
+        from lightgbm_tpu import telemetry
+        snap = telemetry.snapshot()
+        snap["gauges"] = {k: round(v, 3)
+                         for k, v in snap.get("gauges", {}).items()}
+        return snap
     except Exception:
         return None
 
 
 def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
-                 extra_params=None):
+                 extra_params=None, trace=False):
     import numpy as np
     import jax
     import lightgbm_tpu as lgb
@@ -304,9 +304,36 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
     rows_per_tree = booster._boosting.rows_streamed_per_tree
     mark(f"rows_streamed_per_tree={rows_per_tree:.0f} "
          f"(compaction={'on' if hist_compaction else 'off'})")
-    return (sec_per_iter, phases, auc, max(args.rounds, done), rows_per_tree,
-            disp_per_iter, host_bytes_per_iter, predict_rps,
-            predict_host_bytes, trees_per_dispatch)
+
+    # windowed device-trace capture (--trace-dir/--trace-iters): drive
+    # jax.profiler start/stop around N WARM boosting iterations through
+    # telemetry.trace_window — the TraceAnnotation scopes mean the
+    # grower phases land labeled in the perfetto trace, so a TPU round
+    # ships real device timings instead of the modeled mfu_est. Runs on
+    # the main booster only (trace=True), tolerant of backends whose
+    # profiler cannot start (tw.error lands in the JSON, never a raise).
+    trace_info = None
+    if trace and getattr(args, "trace_dir", None):
+        from lightgbm_tpu import telemetry
+        t_iters = max(1, int(getattr(args, "trace_iters", 3)))
+        with telemetry.trace_window(args.trace_dir, iters=t_iters) as tw:
+            for _ in range(t_iters):
+                booster.update()
+            _ = float(booster._boosting.train_score[0].ravel()[0])
+        trace_info = tw.to_json()
+        trace_info["files"] = len(telemetry.trace_files(args.trace_dir))
+        mark(f"trace capture ({'ok' if tw.ok else tw.error}, "
+             f"{trace_info['files']} artifact files)")
+
+    return {"sec_per_iter": sec_per_iter, "phases": phases, "auc": auc,
+            "rounds_run": max(args.rounds, done),
+            "rows_per_tree": rows_per_tree,
+            "disp_per_iter": disp_per_iter,
+            "host_bytes_per_iter": host_bytes_per_iter,
+            "predict_rps": predict_rps,
+            "predict_host_bytes": predict_host_bytes,
+            "trees_per_dispatch": trees_per_dispatch,
+            "trace": trace_info}
 
 
 def phase_scope_probe(rows, args, hist_method="auto", iters=3):
@@ -355,16 +382,22 @@ def phase_scope_probe(rows, args, hist_method="auto", iters=3):
     return out
 
 
-def sentinel_overhead_probe(rows, args, iters=8, repeats=3):
-    """Cost of the in-program numerics sentinels on the fused iteration
-    (check_numerics with fused_iteration — the training-integrity layer's
-    guard): time the same fused training loop with the guard off and on
-    at the same scale and return (sec_off, sec_on, overhead_pct). The
-    guard's budget is <= 2% — the flag word is a handful of reductions
-    riding the step's epilogue, fetched by lazy non-blocking drains.
+def overhead_probe(rows, args, param, iters=8, repeats=3):
+    """Cost of one always-on guard on the fused iteration, measured as
+    off-vs-on timed loops at the same scale; returns
+    (sec_off, sec_on, overhead_pct). Two consumers:
+
+    - ``param="check_numerics"`` — the in-program numerics sentinels
+      (training-integrity layer); budget <= 2% (the flag word is a
+      handful of reductions riding the step's epilogue, fetched by lazy
+      non-blocking drains);
+    - ``param="telemetry_flight_recorder"`` — the per-iteration flight
+      recorder; budget <= 2% (host-side dict builds only — the record
+      never forces a device sync or an extra dispatch).
+
     The two arms run as INTERLEAVED timed windows and each arm takes its
     MINIMUM: single-window timing noise on a 1-core container (±15% at
-    probe scale) would otherwise swamp the budget being measured."""
+    probe scale) would otherwise swamp the budgets being measured."""
     import numpy as np
     import lightgbm_tpu as lgb
     rng = np.random.RandomState(0)
@@ -380,7 +413,7 @@ def sentinel_overhead_probe(rows, args, iters=8, repeats=3):
             "objective": "binary", "num_leaves": args.num_leaves,
             "learning_rate": 0.1, "max_bin": args.max_bin,
             "min_data_in_leaf": 100, "min_sum_hessian_in_leaf": 100.0,
-            "verbosity": -1, "check_numerics": guard,
+            "verbosity": -1, param: guard,
         }, train_set=ds)
         booster.update()
         booster.update()                        # warmup (compile)
@@ -440,6 +473,17 @@ def main():
                          "measure the cold/warm delta; '' disables)")
     ap.add_argument("--no-warm-probe", action="store_true",
                     help="skip the second-process warm-start probe")
+    ap.add_argument("--trace-dir", default=None, dest="trace_dir",
+                    help="capture a jax.profiler device trace of "
+                         "--trace-iters warm boosting iterations into "
+                         "this directory (telemetry.trace_window; the "
+                         "TIMETAG TraceAnnotation scopes label the "
+                         "grower phases in the perfetto trace). The "
+                         "outcome — including WHY a capture failed — "
+                         "lands in the result JSON 'trace' field")
+    ap.add_argument("--trace-iters", type=int, default=3,
+                    dest="trace_iters",
+                    help="boosting iterations the trace window covers")
     ap.add_argument("--warm-child", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.warm_child:
@@ -515,8 +559,7 @@ def main():
         r for r in (args.rows, 2_000_000, 500_000) if r <= args.rows))
     if args.no_ladder:
         ladder = [args.rows]
-    sec_per_iter = phases = used_rows = auc = rounds_run = None
-    used_method = rows_per_tree = None
+    main_run = used_rows = used_method = None
     # the method ladder guards against a kernel-specific failure: "auto"
     # (the fused Pallas fast path on TPU) falls back to the XLA onehot
     # contraction at the same scale before shrinking rows
@@ -524,10 +567,8 @@ def main():
         for hm in ("auto", "onehot"):
             try:
                 print(f"# trying rows={rows} hist={hm}", file=sys.stderr)
-                (sec_per_iter, phases, auc, rounds_run, rows_per_tree,
-                 disp_per_iter, host_bytes_per_iter, predict_rps,
-                 predict_host_bytes, trees_per_dispatch) = \
-                    run_at_scale(rows, args, hist_method=hm)
+                main_run = run_at_scale(rows, args, hist_method=hm,
+                                        trace=True)
                 used_rows = rows
                 used_method = hm
                 break
@@ -537,6 +578,20 @@ def main():
                       file=sys.stderr)
         if used_rows is not None:
             break
+
+    if main_run is not None:
+        sec_per_iter = main_run["sec_per_iter"]
+        phases = main_run["phases"]
+        auc = main_run["auc"]
+        rounds_run = main_run["rounds_run"]
+        rows_per_tree = main_run["rows_per_tree"]
+        disp_per_iter = main_run["disp_per_iter"]
+        host_bytes_per_iter = main_run["host_bytes_per_iter"]
+        predict_rps = main_run["predict_rps"]
+        predict_host_bytes = main_run["predict_host_bytes"]
+        trees_per_dispatch = main_run["trees_per_dispatch"]
+    else:
+        sec_per_iter = None
 
     if sec_per_iter is None:
         print(json.dumps({"metric": "higgs_sec_per_iter", "value": None,
@@ -620,12 +675,16 @@ def main():
         "compile_cache_hits": _compile_totals()["hits"],
         "compile_cache_misses": _compile_totals()["misses"],
         "phases": {k: round(v, 3) for k, v in phases.items()},
-        # training-supervision health (distributed.health_snapshot +
-        # profiling gauges): supervisor restart count, last completed
-        # iteration, and — in supervised multi-process runs — the
-        # per-rank heartbeat ages/iterations. Single-process benches
-        # record restart_count 0 and no heartbeat table.
-        "health": _health_json(),
+        # windowed device-trace capture outcome (--trace-dir): where the
+        # perfetto trace landed, how many iterations it covers, and —
+        # crucially, after BENCH_r04/r05 — WHY it failed when it did
+        "trace": main_run.get("trace"),
+        # the unified telemetry snapshot (telemetry.snapshot(), one
+        # versioned schema): scopes, counters, gauges, dispatch counters
+        # and distributed.health_snapshot() — the supervisor restart
+        # count, heartbeat table, degradation log and flight-recorder
+        # path all live under its "health" key
+        "telemetry": _telemetry_json(),
     }
     # insurance: print the headline line NOW — a later probe that wedges
     # the tunnel (observed 2026-07-31) must not cost the round its number.
@@ -664,9 +723,9 @@ def main():
     nc_sec = nc_rows = None
     if probe_headroom("nocompact"):
         try:
-            nc_sec, _, _, _, nc_rows, _, _, _, _, _ = run_at_scale(
-                used_rows, args, hist_method=used_method,
-                hist_compaction=False)
+            nc = run_at_scale(used_rows, args, hist_method=used_method,
+                              hist_compaction=False)
+            nc_sec, nc_rows = nc["sec_per_iter"], nc["rows_per_tree"]
             print(f"# nocompact probe: {nc_sec:.3f} s/iter, "
                   f"rows/tree={nc_rows:.0f} (compacted run: "
                   f"{sec_per_iter:.3f} s/iter, {rows_per_tree:.0f})",
@@ -689,17 +748,34 @@ def main():
     sent_pct = None
     if probe_headroom("sentinel"):
         try:
-            s_off, s_on, sent_pct = sentinel_overhead_probe(
-                min(used_rows, 200_000), args)
+            s_off, s_on, sent_pct = overhead_probe(
+                min(used_rows, 200_000), args, "check_numerics")
             print(f"# sentinel probe: off {s_off:.4f} s/iter, on "
                   f"{s_on:.4f} s/iter -> {sent_pct:+.2f}%",
                   file=sys.stderr)
         except Exception:
             traceback.print_exc(file=sys.stderr)
             print("# sentinel probe failed; omitting", file=sys.stderr)
+    # flight-recorder overhead (the telemetry layer's always-on ring):
+    # same interleaved-min off/on measurement, same <= 2% budget — the
+    # record is host-side dict builds only, so the number should be
+    # noise around zero on every backend
+    rec_pct = None
+    if probe_headroom("recorder"):
+        try:
+            r_off, r_on, rec_pct = overhead_probe(
+                min(used_rows, 200_000), args, "telemetry_flight_recorder")
+            print(f"# recorder probe: off {r_off:.4f} s/iter, on "
+                  f"{r_on:.4f} s/iter -> {rec_pct:+.2f}%",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            print("# recorder probe failed; omitting", file=sys.stderr)
     result.update({
         "sentinel_overhead_pct": round(sent_pct, 2)
         if sent_pct is not None else None,
+        "recorder_overhead_pct": round(rec_pct, 2)
+        if rec_pct is not None else None,
     })
     print(json.dumps(result), flush=True)
 
@@ -775,9 +851,10 @@ def main():
     q8_sec = q8_auc = q8_mfu = q8_ref_auc = None
     if probe_headroom("q8"):
         try:
-            q8_sec, q8_ph, q8_auc, _, _, _, _, _, _, _ = run_at_scale(
-                probe_rows, probe_args, hist_method="auto",
-                extra_params={"quantized_grad": True})
+            q8 = run_at_scale(probe_rows, probe_args, hist_method="auto",
+                              extra_params={"quantized_grad": True})
+            q8_sec, q8_ph, q8_auc = (q8["sec_per_iter"], q8["phases"],
+                                     q8["auc"])
             q8_mfu = mfu_estimates(
                 q8_sec, probe_rows, probe_args.features, probe_args.max_bin,
                 probe_args.num_leaves, "pallas_q8")["mfu_mode"]
@@ -790,8 +867,8 @@ def main():
             elif probe_headroom("q8-f32-ref"):
                 # reduced-scale probe (CPU fallback): the q8 AUC needs an
                 # f32 reference at the SAME scale to be a quality delta
-                _, _, q8_ref_auc, _, _, _, _, _, _, _ = run_at_scale(
-                    probe_rows, probe_args, hist_method=used_method)
+                q8_ref_auc = run_at_scale(
+                    probe_rows, probe_args, hist_method=used_method)["auc"]
                 print(f"# q8 f32 reference auc={q8_ref_auc}",
                       file=sys.stderr)
         except Exception:
@@ -808,8 +885,9 @@ def main():
     if args.max_bin != 63 and probe_headroom("bin63"):
         b63_args = argparse.Namespace(**{**vars(probe_args), "max_bin": 63})
         try:
-            b63_sec, b63_ph, b63_auc, _, _, _, _, _, _, _ = run_at_scale(
-                probe_rows, b63_args, hist_method="auto")
+            b63 = run_at_scale(probe_rows, b63_args, hist_method="auto")
+            b63_sec, b63_ph, b63_auc = (b63["sec_per_iter"], b63["phases"],
+                                        b63["auc"])
             print(f"# max_bin=63: {b63_sec:.3f} s/iter, "
                   f"auc={b63_auc}", file=sys.stderr)
             for kk, vv in b63_ph.items():
@@ -821,9 +899,10 @@ def main():
         # the projected fastest configuration, with its own AUC readout
         if probe_headroom("bin63+q8"):
             try:
-                b63q8_sec, _, b63q8_auc, _, _, _, _, _, _, _ = run_at_scale(
-                    probe_rows, b63_args, hist_method="auto",
-                    extra_params={"quantized_grad": True})
+                b63q8 = run_at_scale(probe_rows, b63_args,
+                                     hist_method="auto",
+                                     extra_params={"quantized_grad": True})
+                b63q8_sec, b63q8_auc = b63q8["sec_per_iter"], b63q8["auc"]
                 print(f"# max_bin=63 + q8: {b63q8_sec:.3f} s/iter, "
                       f"auc={b63q8_auc}", file=sys.stderr)
             except Exception:

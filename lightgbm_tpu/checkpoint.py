@@ -96,7 +96,14 @@ _NON_TRAINING_PARAMS = frozenset({
     # serving-front-end knobs: batching/deadline/admission policy for the
     # ServeFrontend — pure request-routing, never touches training
     "serve_flush_ms", "serve_max_batch_rows", "serve_max_queue_rows",
-    "serve_deadline_ms",
+    "serve_deadline_ms", "serve_metrics", "serve_metrics_port",
+    "serve_metrics_host",
+    # telemetry knobs (lightgbm_tpu/telemetry.py): the flight recorder
+    # observes training from already-fetched host values — ring size,
+    # flush cadence and destination can all differ between the
+    # checkpointing run and the resuming run without touching the model
+    "telemetry_flight_recorder", "telemetry_ring_size", "telemetry_dir",
+    "telemetry_flush_period",
     "fault_kill_at_iter", "fault_hang_at_iter", "fault_kill_in_ckpt_write",
     "fault_nan_grad_at_iter", "fault_corrupt_checkpoint",
     "fault_kill_rank_at_iter", "fault_hang_rank_at_iter",

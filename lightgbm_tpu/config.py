@@ -548,6 +548,43 @@ class Config:
     # not answered in time raises a ServeTimeoutError naming the phase it
     # died in (queue-wait vs dispatch); per-request deadline_ms overrides
     serve_deadline_ms: float = 0.0
+    # expose a Prometheus-style text metrics endpoint on the
+    # ServeFrontend (GET /metrics renders telemetry.prometheus_text():
+    # lightgbm_tpu_serve_p99_ms and friends from the latency ring, plus
+    # the scopes/counters/dispatch/health planes) — started when the
+    # first model registers
+    serve_metrics: bool = False
+    # TCP port for the /metrics endpoint (0 = an ephemeral port; read the
+    # bound address from ServeFrontend.metrics_addr)
+    serve_metrics_port: int = 0
+    # bind host for the /metrics endpoint. Loopback by default — the
+    # exposition has no auth, so exposing it is an explicit decision:
+    # set "0.0.0.0" (or a specific interface) for the standard off-host
+    # Prometheus scrape deployment
+    serve_metrics_host: str = "127.0.0.1"
+
+    # Telemetry (lightgbm_tpu/telemetry.py)
+    # per-iteration flight recorder: a bounded in-memory ring of
+    # structured records (phase wall-time deltas, dispatch/transfer
+    # deltas, sentinel verdicts, OOM rungs, heartbeat ages) flushed to
+    # JSONL atomically on watchdog fire / divergence verdict /
+    # OOM-ladder exhaustion / training error / fault-harness kill — any
+    # dead gang or failed TPU round leaves a self-describing
+    # post-mortem. Reads only already-fetched host values (never forces
+    # a device sync): recorder-on training keeps the fused path at 2
+    # dispatches/iteration and within the <=2% overhead budget
+    telemetry_flight_recorder: bool = True
+    # how many per-iteration records the flight-recorder ring retains
+    telemetry_ring_size: int = 256
+    # where flight-recorder JSONLs flush ("" = the supervisor's diag dir
+    # when supervised, else <checkpoint_path>/telemetry, else a temp dir
+    # created only when an event flush actually fires)
+    telemetry_dir: str = ""
+    # with a durable telemetry directory configured, also flush the ring
+    # every this many iterations (a REAL SIGKILL cannot flush, so the
+    # periodic flush bounds the post-mortem loss to one period; 0 = only
+    # event-driven flushes)
+    telemetry_flush_period: int = 64
 
     def __post_init__(self):
         if self.seed is not None:

@@ -918,6 +918,16 @@ class CollectiveWatchdog:
                                      diag["suspects"], diag["phase"],
                                      self.deadline)
         log.warning(f"watchdog: {msg}")
+        # flush the flight recorder NOW (the training thread is stalled
+        # inside the very collective being diagnosed) and embed its path
+        # in the diagnosis: the supervisor report then references a
+        # per-iteration post-mortem, not just the final stack state
+        try:
+            from . import telemetry
+            diag["flight_recorder"] = telemetry.flush_recorder(
+                f"watchdog: {msg}")
+        except Exception:
+            pass
         if self.diag_dir:
             try:
                 os.makedirs(self.diag_dir, exist_ok=True)
@@ -1048,7 +1058,31 @@ def health_snapshot() -> dict:
              if k.startswith("serve_")}
     if serve:
         out["serve"] = serve
+    # flight-recorder post-mortem path BY REFERENCE (telemetry.py): a
+    # checkpoint manifest or bench JSON embedding this snapshot tells an
+    # operator where the per-iteration ring flushes, without inlining it
+    try:
+        from . import telemetry
+        fr = telemetry.recorder_path()
+        if fr:
+            out["flight_recorder"] = fr
+    except Exception:
+        pass
     return out
+
+
+def heartbeat_ages() -> Optional[Dict[str, float]]:
+    """Per-rank heartbeat ages (seconds since last report) when a
+    heartbeat monitor is live in this process, else None. The cheap
+    host-side accessor the flight recorder records each iteration."""
+    h = _active_health
+    if h is None or h.heartbeat is None:
+        return None
+    try:
+        return {str(r): float(e.get("age", -1.0))
+                for r, e in h.heartbeat.table().items()}
+    except Exception:
+        return None
 
 
 # ====================================================== training integrity
@@ -1267,6 +1301,13 @@ def check_model_integrity(boosting, iteration: int,
             diag = {"rank": rank, "iteration": int(iteration),
                     "corrupt_ranks": corrupt, "fingerprints": table,
                     "kind": "divergence"}
+            try:
+                from . import telemetry
+                diag["flight_recorder"] = telemetry.flush_recorder(
+                    f"divergence: rank {rank} voted corrupt at iteration "
+                    f"{iteration}")
+            except Exception:
+                pass
             if diag_dir:
                 try:
                     os.makedirs(diag_dir, exist_ok=True)

@@ -277,9 +277,26 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         # flag words are fetched lazily; without this flush a NaN born in
         # the final rounds could go unreported)
         boosting._flush_sentinel()
+    except BaseException as e:
+        # a dying run flushes its flight recorder (telemetry.py): the
+        # per-iteration ring + this reason are the post-mortem — the NaN
+        # sentinel verdict, watchdog diagnosis or OOM ladder history is
+        # on disk before the exception unwinds. THIS booster's recorder,
+        # not the module slot: in multi-booster processes (cv folds) the
+        # module slot holds the last-configured booster's ring.
+        if hasattr(boosting, "_flush_flight"):
+            boosting._flush_flight(
+                f"train-error: {type(e).__name__}: {str(e)[:300]}")
+        raise
     finally:
         boosting._block_target = None
         health.stop()
+    # clean end: flush only when a durable telemetry dir was configured
+    # (telemetry_dir / supervised diag dir / checkpoint_path) — ordinary
+    # runs must not litter temp dirs with post-mortems nobody asked for
+    fr = getattr(boosting, "_flight", None)
+    if fr is not None and fr.directory:
+        fr.flush("train-end")
     return booster
 
 

@@ -20,6 +20,7 @@ prediction) and as host ``HostTree`` objects for model IO/SHAP.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -337,6 +338,8 @@ class GBDT:
                                  # boosters that never trained here
     _coll_bytes_dev = 0.0        # ditto (collective-volume telemetry)
     _fault_plan = None           # set per-train (utils/faults injection)
+    _flight = None               # per-train flight recorder (telemetry.py);
+                                 # None for loaded boosters / when disabled
     _bag_stale = False           # fused iterations draw bagging in-program;
                                  # the host mask re-derives on next use
     _serve_mode = False          # ServeFrontend registration flips it on:
@@ -367,6 +370,12 @@ class GBDT:
         # degradation log: this booster's health snapshots / checkpoint
         # manifests must not inherit an earlier booster's OOM events
         distributed.reset_degradations()
+        # per-iteration flight recorder (telemetry.py): a fresh ring per
+        # training run, fed from host-side values only in train_one_iter
+        # (the resolved-context header fills lazily at the first record,
+        # after autotune has settled the real histogram method)
+        from .. import telemetry
+        self._flight = telemetry.configure(cfg)
         # persistent XLA compile cache (compile_cache_dir): pay each
         # program compile once per shape EVER, not once per process
         from .. import compile_cache
@@ -1352,8 +1361,16 @@ class GBDT:
         failed step mutates no trainer state (checked: the tree count must
         be unchanged)."""
         from .. import distributed
-        from ..utils import faults
+        from ..utils import faults, profiling
         it = self.iter
+        # flight-recorder bookkeeping (host-side snapshots only — a dict
+        # copy and a clock read; the record itself is built in the
+        # finally so a failed step still leaves an in-flight record)
+        flight = self._flight
+        t_rec = time.time() if flight is not None else 0.0
+        disp0 = profiling.dispatch_stats() if flight is not None else None
+        sc0 = profiling.scopes() \
+            if flight is not None and profiling.enabled() else None
         distributed.notify_step_begin(it)
         try:
             while True:
@@ -1373,6 +1390,17 @@ class GBDT:
             # on success self.iter advanced past ``it``: record completion;
             # on an exception the step did NOT complete and last_iter stays
             distributed.notify_step_end(it if self.iter > it else it - 1)
+            if flight is not None:
+                # telemetry must never kill the run it observes — and in
+                # this finally an escaping record error would REPLACE a
+                # real training exception. A failing recorder disarms
+                # itself (one warning, not one per iteration).
+                try:
+                    self._record_flight(flight, it, t_rec, disp0, sc0)
+                except Exception as e:
+                    self._flight = None
+                    log.warning(f"flight recorder disabled after record "
+                                f"failure: {e}")
         if self._fault_plan is not None:
             # silent-corruption injection (FLIP_SCORE_RANK): one score-
             # cache bit flipped AFTER the iteration completes, on one rank
@@ -2106,11 +2134,14 @@ class GBDT:
         ``boost_rounds_per_dispatch`` block, oldest first so the FIRST
         poisoned iteration is the one named)."""
         arr = np.atleast_1d(np.asarray(flags))
-        if arr.size == 1:
-            self._check_sentinel_flags(int(arr[0]), it)
-            return
         for j in range(arr.size):
-            self._check_sentinel_flags(int(arr[j]), it + j)
+            word = int(arr[j])
+            if self._flight is not None:
+                # back-fill the verdict into the covering flight record
+                # BEFORE judging: a nonzero word raises, and the flushed
+                # post-mortem must name the poisoned iteration
+                self._flight.note_sentinel(it + j, word)
+            self._check_sentinel_flags(word, it + j)
 
     # ------------------------------------------------ OOM degradation
     def _eff_hist_block(self, blk: int) -> int:
@@ -2153,6 +2184,9 @@ class GBDT:
         except Exception:
             score_gone = False
         if score_gone:
+            self._flush_flight(
+                f"oom-exhausted: donated score cache consumed at "
+                f"iteration {self.iter}")
             # the K-block step DONATES the score cache; an OOM during
             # EXECUTION (not compile — the common case — which fails
             # before any donation) may have consumed the buffer, so the
@@ -2174,6 +2208,9 @@ class GBDT:
             # reduction contract — and be named corrupt by the very
             # divergence vote this layer adds. The supervisor's
             # restart/shrink path owns rank-local resource failures.
+            self._flush_flight(
+                f"oom-exhausted: multi-process fail-stop at iteration "
+                f"{self.iter}")
             log.warning(
                 f"RESOURCE_EXHAUSTED in boosting iteration {self.iter}: "
                 f"per-rank degradation is disabled in multi-process gangs "
@@ -2183,6 +2220,12 @@ class GBDT:
         if len(self.trees) != ntrees_before:
             return False
         if self._oom_level >= 3:
+            # ladder exhausted: the exception re-raises and kills the run
+            # — the flushed ring is the post-mortem naming every rung
+            # this booster already stepped down
+            self._flush_flight(
+                f"oom-exhausted: ladder spent at iteration {self.iter} "
+                f"(level {self._oom_level}/3)")
             return False
         self._oom_level += 1
         if self._oom_level == 1:
@@ -2246,6 +2289,74 @@ class GBDT:
         log.warning(f"RESOURCE_EXHAUSTED in predict: degrading ({action}) "
                     f"and retrying")
         return True
+
+    def _flush_flight(self, reason: str) -> Optional[str]:
+        """Flush THIS booster's flight recorder (not the process-global
+        one): in multi-booster processes — lgb.cv folds, bench probes —
+        the module slot holds the last-configured booster's ring, and a
+        fold-0 OOM post-mortem carrying fold k-1's records would
+        misattribute the failure. Context-free flush paths (watchdog,
+        faults._hard_exit) still use the module recorder, the best
+        available without a booster in hand."""
+        if self._flight is None:
+            return None
+        return self._flight.flush(reason)
+
+    def _record_flight(self, flight, it: int, t0: float,
+                       disp0, sc0) -> None:
+        """Append one flight-recorder record for the update() that began
+        at iteration ``it`` (a K-block covers several iterations; a
+        failed step records completed=False with the in-flight
+        iteration). Reads ONLY host-side state — phase deltas come from
+        the TIMETAG scope table (empty when profiling is off), the
+        cumulative coll_bytes/rows counters are the host mirrors TIMETAG
+        mode already fetched, and the sentinel column is back-filled by
+        the lazy drain (_judge_sentinel) when verdicts land — so the
+        record never forces a device sync or an extra dispatch."""
+        from .. import distributed
+        from ..utils import profiling
+        consumed = self.iter - it
+        phases = None
+        if sc0 is not None:
+            phases = {}
+            for name, sc in profiling.scopes().items():
+                d = sc["total_s"] - sc0.get(name, {}).get("total_s", 0.0)
+                if d > 0:
+                    phases[name] = round(d, 6)
+        sentinel = "off"
+        if self.config.check_numerics:
+            sentinel = "pending" if self._sentinel_pending else "ok"
+        counters = profiling.counters() if sc0 is not None else {}
+        hb = distributed.heartbeat_ages()
+        flight.record(
+            iteration=it, iters=max(consumed, 1),
+            completed=consumed > 0,
+            wall_s=time.time() - t0, phases=phases,
+            dispatch=profiling.dispatch_delta(disp0) if disp0 else None,
+            sentinel=sentinel, oom_level=self._oom_level,
+            coll_bytes=counters.get("hist_coll_bytes"),
+            rows_streamed=counters.get("hist_rows_streamed"),
+            heartbeat_age=(max(hb.values()) if hb else None))
+        if not flight.has_context:
+            # resolved execution context, filled AFTER the first step so
+            # autotune/auto-selection have settled the real method; the
+            # split_fusion flag resolves through the SAME feature-block
+            # the grower statics used (fb nonzero — memory-bounded
+            # growth — disables the fusion, and a post-mortem claiming
+            # the fused path ran would misdirect exactly the
+            # memory-pressure debugging it exists for)
+            hm = self._hist_method()
+            fb = self._feature_block(hm)
+            flight.set_context(
+                backend=jax.default_backend(), boosting=self.name,
+                hist_method=hm,
+                split_fusion=bool(self._split_fusion_on(hm, fb)),
+                quantized_grad=bool(getattr(self.config, "quantized_grad",
+                                            False)),
+                rounds_per_dispatch=int(getattr(
+                    self.config, "boost_rounds_per_dispatch", 1)),
+                num_leaves=int(self.config.num_leaves),
+                tree_learner=self.config.tree_learner)
 
     def _record_aux_counters(self, aux: GrowAux) -> None:
         """Accumulate a tree's histogram-pass row count and collective

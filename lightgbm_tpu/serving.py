@@ -56,6 +56,15 @@ Health gauges (``utils/profiling.set_gauge``, always-on, surfaced by
 ``serve_inflight_rows``, ``serve_shed_count``, ``serve_timeout_count``,
 ``serve_requests``, ``serve_batches``, ``serve_p50_ms``, ``serve_p99_ms``.
 
+Metrics exposition (``serve_metrics=True`` / ``metrics=True``): a
+Prometheus-style text endpoint — ``GET /metrics`` renders
+``telemetry.prometheus_text()`` (``lightgbm_tpu_serve_p99_ms`` and
+friends from the latency ring, plus the scopes/counters/dispatch/health
+planes) from a daemon HTTP listener on ``serve_metrics_port`` (0 = an
+ephemeral port; read :attr:`ServeFrontend.metrics_addr`). The handler
+first mirrors the frontend's AUTHORITATIVE counters into the gauges, so
+a scrape never reads stale percentiles.
+
 Fault drills (``utils/faults.py``, env + config twins):
 ``LGBM_TPU_FAULT_SLOW_PREDICT_MS`` delays inside the dispatch path;
 ``LGBM_TPU_FAULT_OOM_AT_PREDICT`` raises simulated RESOURCE_EXHAUSTED
@@ -233,7 +242,9 @@ class ServeFrontend:
                  flush_ms: Optional[float] = None,
                  max_batch_rows: Optional[int] = None,
                  max_queue_rows: Optional[int] = None,
-                 default_deadline_ms: Optional[float] = None):
+                 default_deadline_ms: Optional[float] = None,
+                 metrics: Optional[bool] = None,
+                 metrics_port: Optional[int] = None):
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: deque = deque()
@@ -260,6 +271,11 @@ class ServeFrontend:
             else int(max_queue_rows)
         self._default_deadline_ms = None if default_deadline_ms is None \
             else float(default_deadline_ms)
+        self._metrics = None if metrics is None else bool(metrics)
+        self._metrics_port = None if metrics_port is None \
+            else int(metrics_port)
+        self._metrics_server = None
+        self._metrics_thread: Optional[threading.Thread] = None
         self._thread = threading.Thread(
             target=self._run, name="lgbm-tpu-serve-dispatch", daemon=True)
         self._thread.start()
@@ -322,6 +338,19 @@ class ServeFrontend:
     def default_deadline_ms(self) -> float:
         return float(self._policy("serve_deadline_ms",
                                   self._default_deadline_ms, 0.0))
+
+    @property
+    def metrics_enabled(self) -> bool:
+        return bool(self._policy("serve_metrics", self._metrics, False))
+
+    @property
+    def metrics_port(self) -> int:
+        return int(self._policy("serve_metrics_port", self._metrics_port,
+                                0))
+
+    @property
+    def metrics_host(self) -> str:
+        return str(self._policy("serve_metrics_host", None, "127.0.0.1"))
 
     def _validate(self, booster, probe: np.ndarray,
                   expect_arity: Optional[int] = None) -> int:
@@ -426,6 +455,18 @@ class ServeFrontend:
             if self._policy_name is None:
                 self._policy_name = name
         profiling.set_gauge("serve_models", float(len(self._registry)))
+        # metrics endpoint policy resolves through the registered
+        # booster's config — (re)check it now that one exists. Best
+        # effort: the model is already committed to the registry, and a
+        # bind failure (port in use by another frontend, a stale
+        # listener) must not turn a successful registration into an
+        # error — explicit start_metrics_server() calls still raise
+        if self.metrics_enabled:
+            try:
+                self.start_metrics_server()
+            except Exception as e:
+                log.warning(f"serve: metrics endpoint failed to start "
+                            f"(continuing without it): {e}")
         log.info(f"serve: registered model {name!r} v{version} "
                  f"(arity {arity}, probe {probe.shape[0]} rows)")
         return version
@@ -792,6 +833,96 @@ class ServeFrontend:
             req.phase = "done"
             req.event.set()
 
+    # ------------------------------------------------------------ metrics
+    def metrics_text(self) -> str:
+        """The Prometheus-style exposition of :func:`telemetry.snapshot`
+        — what ``GET /metrics`` serves. Mirrors the frontend's
+        AUTHORITATIVE counters (requests/batches/shed/timeouts/latency
+        percentiles, computed under the frontend lock) into the serve_*
+        gauges first, so a scrape never reads the throttled refresh's
+        stale percentiles."""
+        from . import telemetry
+        st = self.stats()
+        profiling.set_gauge("serve_requests", float(st["requests"]))
+        profiling.set_gauge("serve_batches", float(st["batches"]))
+        profiling.set_gauge("serve_shed_count", float(st["shed"]))
+        profiling.set_gauge("serve_timeout_count", float(st["timeouts"]))
+        profiling.set_gauge("serve_queue_rows", float(st["queued_rows"]))
+        profiling.set_gauge("serve_inflight_rows",
+                            float(st["inflight_rows"]))
+        if "p50_ms" in st:
+            profiling.set_gauge("serve_p50_ms", st["p50_ms"])
+            profiling.set_gauge("serve_p99_ms", st["p99_ms"])
+        return telemetry.prometheus_text()
+
+    def start_metrics_server(self, port: Optional[int] = None,
+                             host: Optional[str] = None) -> str:
+        """Start (idempotently) the daemon HTTP listener serving
+        ``GET /metrics`` and return its ``host:port`` address. ``port``/
+        ``host`` override the ``serve_metrics_port``/``serve_metrics_host``
+        policies (0 = ephemeral port; the default host is LOOPBACK — the
+        exposition has no auth, so off-host scraping requires opting in
+        with ``serve_metrics_host="0.0.0.0"`` or an interface address)."""
+        with self._lock:
+            if self._metrics_server is not None:
+                return self.metrics_addr
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        frontend = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 — http.server API
+                if self.path.split("?", 1)[0].rstrip("/") \
+                        not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    body = frontend.metrics_text().encode()
+                    status = 200
+                except Exception as e:
+                    # the scrape must not kill the server, but a broken
+                    # exposition must read as a FAILED scrape (500), not
+                    # a successful empty one — up==1 with every series
+                    # silently stale would defeat scrape alerting
+                    body = f"# metrics render failed: {e}\n".encode()
+                    status = 500
+                self.send_response(status)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):   # scrapes are not log events
+                pass
+
+        srv = ThreadingHTTPServer(
+            (self.metrics_host if host is None else str(host),
+             int(self.metrics_port if port is None else port)),
+            _Handler)
+        srv.daemon_threads = True
+        thread = threading.Thread(target=srv.serve_forever,
+                                  name="lgbm-tpu-serve-metrics", daemon=True)
+        with self._lock:
+            if self._metrics_server is not None:   # lost the race
+                srv.server_close()
+                return self.metrics_addr
+            self._metrics_server = srv
+            self._metrics_thread = thread
+        thread.start()
+        addr = self.metrics_addr
+        log.info(f"serve: metrics endpoint at http://{addr}/metrics")
+        return addr
+
+    @property
+    def metrics_addr(self) -> Optional[str]:
+        """``host:port`` of the live metrics listener (None when off)."""
+        srv = self._metrics_server
+        if srv is None:
+            return None
+        host, port = srv.server_address[:2]
+        return f"{host}:{port}"
+
     # ------------------------------------------------------------- status
     def stats(self) -> dict:
         """Frontend counters (authoritative; the serve_* gauges mirror
@@ -823,6 +954,13 @@ class ServeFrontend:
                 return
             self._closing = True
             self._cond.notify_all()
+            srv, self._metrics_server = self._metrics_server, None
+            mthread, self._metrics_thread = self._metrics_thread, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+            if mthread is not None:
+                mthread.join(timeout=10.0)
         self._thread.join(timeout=30.0)
         # release serve resources: a closed frontend must not leave its
         # boosters pinning donated per-bucket device buffers or routing

@@ -37,8 +37,12 @@ REJECTED by ``train(resume_from=...)``::
 
 Children run with ``LGBM_TPU_SUPERVISED=1``: a rank whose collective
 watchdog fires exits with ``WATCHDOG_EXIT_CODE`` (writing a JSON diagnosis
-the supervisor folds into its report) instead of raising, since a rank
-stuck inside a native collective cannot be unstuck from Python. A rank
+the supervisor folds into its report — the diagnosis references the
+rank's flushed flight-recorder JSONL, see ``telemetry.py`` and
+``GangFailure.flight_recorders``, so every failure leaves a
+per-iteration post-mortem next to the stall verdict) instead of raising,
+since a rank stuck inside a native collective cannot be unstuck from
+Python. A rank
 the cross-rank integrity check (``integrity_check_period``) identifies as
 holding silently-diverged state exits with ``DIVERGENCE_EXIT_CODE`` the
 same way — the supervisor charges ITS restart budget (the divergence vote
@@ -101,6 +105,16 @@ class GangFailure:
         classified permanently lost without burning the per-rank budget."""
         return sorted(r for r, c in self.exit_codes.items()
                       if c == distributed.SPAWN_FAIL_EXIT_CODE)
+
+    @property
+    def flight_recorders(self) -> List[str]:
+        """Per-rank flight-recorder JSONL paths referenced by this
+        incarnation's watchdog/divergence diagnoses (telemetry.py): the
+        per-iteration post-mortems of the failed gang. Ranks that died
+        by harness kill flush too, but reference themselves only from
+        the JSONL — find those as flight_rank*.jsonl in the diag dir."""
+        return sorted({d["flight_recorder"] for d in self.watchdog
+                       if d.get("flight_recorder")})
 
 
 @dataclass

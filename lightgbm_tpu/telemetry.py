@@ -1,0 +1,560 @@
+"""Unified telemetry layer: flight recorder, trace capture, exposition.
+
+Before this module the repo had five disconnected telemetry surfaces —
+the TIMETAG scopes/counters/gauges in ``utils/profiling.py``, the
+dispatch/transfer hook, ``distributed.health_snapshot()``, the
+supervisor/divergence diagnosis JSONs, and ad-hoc snapshot spellings in
+``bench.py`` — with no shared schema, no time axis, and nothing that
+survived a crash (BENCH_r04/r05 published CPU numbers under a TPU
+filename precisely because nothing recorded WHY the TPU probe died).
+This module is the one subsystem every layer reports into:
+
+- :func:`snapshot` — the ONE versioned schema over all of the above
+  (scopes + counters + gauges + dispatch + health, which itself carries
+  the degradation log and the serve gauges), consumed by ``bench.py``,
+  the Prometheus-style ``ServeFrontend`` metrics endpoint
+  (:func:`prometheus_text`), and rank-0 gang aggregation
+  (:func:`gang_snapshot` over ``distributed.exchange_host``).
+
+- :class:`FlightRecorder` — a bounded in-memory ring of per-iteration
+  structured records (phase wall-time deltas, dispatch/transfer deltas,
+  sentinel verdicts, OOM-degradation rungs, heartbeat ages) that flushes
+  to JSONL atomically on watchdog fire / divergence verdict /
+  OOM-ladder exhaustion / training error / fault-harness kill, so any
+  dead gang or failed TPU round leaves a self-describing post-mortem.
+  The recorder reads ONLY already-fetched host values — it rides the
+  lazy sentinel drain and never forces a device sync, so recorder-on
+  training keeps the fused path's 2-dispatches-per-iteration budget
+  (asserted in tests/test_telemetry.py).
+
+- :func:`trace_window` — windowed device-trace capture driving
+  ``jax.profiler`` start/stop around N boosting iterations; the
+  ``TraceAnnotation`` scopes profiling.timer already opens mean the
+  grower phases land labeled in the perfetto trace for free. Exposed as
+  ``bench.py --trace-dir/--trace-iters`` so a TPU BENCH round ships
+  real device timings instead of the modeled ``mfu_est``.
+
+Crash-durability model: the injected kill faults (``utils/faults.py``
+``_hard_exit``) flush the ring before ``os._exit`` — the testable
+stand-in for preemption. A REAL ``SIGKILL`` cannot flush anything, so
+runs with a durable telemetry directory configured (``telemetry_dir``
+param, the supervisor's diag-dir env, or ``checkpoint_path``) also
+flush periodically (``telemetry_flush_period``), bounding the loss to
+one flush period. Watchdog and divergence diagnoses embed the flushed
+path by reference (``"flight_recorder"``), as does
+``health_snapshot()`` — and therefore every checkpoint manifest's
+health section.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+from .utils import log
+
+# Version of BOTH the snapshot schema and the flight-recorder JSONL
+# schema. Bump on any breaking field change; consumers (the smoke
+# script, the supervisor, offline tooling) match on it.
+SCHEMA_VERSION = 1
+
+# record types a flight-recorder JSONL may contain, with their required
+# fields (the machine-checkable half of the schema;
+# validate_flight_jsonl enforces it)
+FLIGHT_RECORD_FIELDS: Dict[str, tuple] = {
+    # one per flushed file, always the first line: run identity + the
+    # resolved execution context (backend, hist_method, split_fusion...)
+    "run": ("schema", "rank", "pid", "context"),
+    # one per boosting update() (a K-block counts as one record covering
+    # ``iters`` iterations starting at ``iteration``)
+    "iter": ("t", "iteration", "iters", "completed", "wall_s", "phases",
+             "dispatch", "sentinel", "oom_level"),
+    # one per flush event, appended in order (every later flush rewrites
+    # the file with the full ring + ALL flush events so far, so an
+    # oom-exhaustion flush survives into the final train-error flush)
+    "flush": ("t", "reason", "health"),
+}
+
+
+def _utcnow() -> float:
+    return time.time()
+
+
+# ============================================================ snapshot
+
+def snapshot() -> Dict[str, Any]:
+    """The unified telemetry snapshot — every surface in one versioned
+    document:
+
+    - ``scopes``/``counters``: the TIMETAG wall-time table and work
+      counters (empty unless profiling is enabled — measurement mode);
+    - ``gauges``: the always-on health gauges (supervisor restarts,
+      heartbeat ages, serve queue/latency, OOM rungs);
+    - ``dispatch``: cumulative compiled-program dispatch / transfer
+      counters (zero until ``profiling.install_dispatch_hook``);
+    - ``health``: ``distributed.health_snapshot()`` — progress,
+      heartbeat table, degradation log, serve gauges, and (when a
+      flight recorder is live) the post-mortem JSONL path.
+
+    Reads only host-side state — never forces a device sync — so it is
+    safe to call from serving threads and the metrics endpoint."""
+    from . import distributed
+    from .utils import profiling
+    return {
+        "schema": SCHEMA_VERSION,
+        "time": _utcnow(),
+        "scopes": profiling.scopes(),
+        "counters": profiling.counters(),
+        "gauges": profiling.gauges(),
+        "dispatch": profiling.dispatch_stats(),
+        "health": distributed.health_snapshot(),
+    }
+
+
+_METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(name: str) -> str:
+    return "lightgbm_tpu_" + _METRIC_NAME_RE.sub("_", str(name))
+
+
+def _metric_value(value) -> str:
+    """Full-precision exposition value: '%g'-style 6-digit rounding
+    would freeze monotonic counters past ~1e6 (rate()/increase() then
+    read zero forever). Integral values print as integers; the rest use
+    repr's shortest round-trip form."""
+    v = float(value)
+    if v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Render a :func:`snapshot` in the Prometheus text exposition
+    format (one metric per line, ``lightgbm_tpu_`` prefix): gauges
+    become first-class metrics (``lightgbm_tpu_serve_p99_ms``), scopes
+    and counters become labeled totals, the dispatch counters and the
+    health scalars ride along. The ``ServeFrontend`` ``/metrics``
+    endpoint serves exactly this."""
+    if snap is None:
+        snap = snapshot()
+    lines: List[str] = [
+        f"# lightgbm_tpu telemetry schema {snap.get('schema', '?')}"]
+    for name, value in sorted((snap.get("gauges") or {}).items()):
+        lines.append(f"{_metric_name(name)} {_metric_value(value)}")
+    for name, sc in sorted((snap.get("scopes") or {}).items()):
+        base = _metric_name("scope")
+        lines.append(f'{base}_seconds_total{{scope="{name}"}} '
+                     f'{_metric_value(sc["total_s"])}')
+        lines.append(f'{base}_calls_total{{scope="{name}"}} '
+                     f'{int(sc["calls"])}')
+    for name, value in sorted((snap.get("counters") or {}).items()):
+        lines.append(f'{_metric_name("counter_total")}{{name="{name}"}} '
+                     f"{_metric_value(value)}")
+    for name, value in sorted((snap.get("dispatch") or {}).items()):
+        lines.append(f"{_metric_name(name + '_total')} {int(value)}")
+    health = snap.get("health") or {}
+    for key in ("restart_count", "last_iteration"):
+        if key in health:
+            lines.append(f"{_metric_name(key)} {int(health[key])}")
+    lines.append(f"{_metric_name('degradations_total')} "
+                 f"{len(health.get('degradations') or [])}")
+    for rank, entry in sorted((health.get("heartbeat") or {}).items()):
+        lines.append(f'{_metric_name("heartbeat_age_seconds")}'
+                     f'{{rank="{rank}"}} '
+                     f'{_metric_value(entry.get("age", -1))}')
+    return "\n".join(lines) + "\n"
+
+
+def gang_snapshot(tag: str = "telemetry") -> List[Dict[str, Any]]:
+    """Allgather every rank's :func:`snapshot` over the coordination
+    service (``distributed.exchange_host`` — pure gRPC, works where
+    cross-process XLA collectives don't), returning them in rank order
+    on EVERY rank. Must be called in lockstep on all ranks, like any
+    exchange. Single-process: ``[snapshot()]``. Rank 0 typically embeds
+    the result in its reports (bench JSON, supervisor smoke)."""
+    from . import distributed
+    mine = snapshot()
+    payloads = distributed.exchange_host(tag, json.dumps(mine))
+    out = []
+    for p in payloads:
+        try:
+            out.append(json.loads(p))
+        except ValueError:
+            out.append({"schema": SCHEMA_VERSION, "error": "unparseable"})
+    return out
+
+
+# ====================================================== flight recorder
+
+class FlightRecorder:
+    """Bounded ring of per-iteration structured records + flush events.
+
+    Training (``GBDT.train_one_iter``) appends one record per update()
+    from values the host ALREADY holds — wall time, dispatch-counter
+    deltas, TIMETAG scope deltas (empty unless profiling is enabled),
+    the OOM-ladder rung, heartbeat ages — so recording costs a dict
+    build, never a device sync or an extra dispatch. Sentinel verdicts
+    arrive LATE by design: the fused path judges its in-program NaN/Inf
+    flag words lazily (the sentinel drain), and ``note_sentinel``
+    back-fills the covering record when the verdict lands.
+
+    ``flush(reason)`` serializes header + ring + every flush event so
+    far to ``flight_rank{r}.jsonl`` atomically (``utils/atomic_write``:
+    a kill mid-flush leaves the previous complete file, never a
+    truncated hybrid). Thread-safe: the watchdog thread flushes
+    concurrently with the training thread recording."""
+
+    def __init__(self, capacity: int = 256, directory: Optional[str] = None,
+                 rank: int = 0, flush_period: int = 0,
+                 incarnation: int = 0):
+        self.capacity = max(1, int(capacity))
+        self.directory = directory or None
+        self.rank = int(rank)
+        self.flush_period = max(0, int(flush_period))
+        # supervised relaunches must not overwrite the DEAD incarnation's
+        # post-mortem: incarnation > 0 gets its own file
+        self.incarnation = int(incarnation)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        # retained flush EVENTS (watchdog/divergence/OOM/error/kill/end)
+        # — bounded like the ring: rare by nature, but a pathological
+        # repeat-flusher must not grow memory or the file without limit
+        self._flushes: deque = deque(maxlen=64)
+        self._context: Dict[str, Any] = {}
+        self._last_path: Optional[str] = None
+        self._last_periodic = 0
+
+    # ------------------------------------------------------- recording
+    def set_context(self, **fields) -> None:
+        """Merge resolved run context (backend, hist_method,
+        split_fusion, rounds-per-dispatch...) into the header record."""
+        with self._lock:
+            self._context.update(fields)
+
+    @property
+    def has_context(self) -> bool:
+        return bool(self._context)
+
+    def record(self, iteration: int, iters: int = 1, completed: bool = True,
+               wall_s: float = 0.0, phases: Optional[Dict[str, float]] = None,
+               dispatch: Optional[Dict[str, int]] = None,
+               sentinel: str = "off", oom_level: int = 0,
+               **fields) -> None:
+        """Append one per-iteration record (a K-block passes iters=K).
+        Extra keyword fields ride along verbatim (coll_bytes, heartbeat
+        ages...). Values must already be host-side."""
+        rec = {"type": "iter", "t": _utcnow(), "iteration": int(iteration),
+               "iters": int(iters), "completed": bool(completed),
+               "wall_s": round(float(wall_s), 6),
+               "phases": dict(phases or {}),
+               "dispatch": {k: int(v) for k, v in (dispatch or {}).items()},
+               "sentinel": sentinel, "oom_level": int(oom_level)}
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        with self._lock:
+            self._ring.append(rec)
+        if (self.flush_period and self.directory
+                and iteration // self.flush_period != self._last_periodic):
+            # durable-dir runs flush every flush_period iterations so a
+            # REAL SIGKILL (which cannot flush) loses at most one
+            # period. Transient: a periodic event is just a checkpoint
+            # of the same ring — retaining each one would grow the file
+            # and the event list linearly with run length (quadratic
+            # total I/O), so only EVENT flushes are kept permanently.
+            self._last_periodic = iteration // self.flush_period
+            self.flush("periodic", retain_event=False)
+
+    def note_sentinel(self, iteration: int, flags: int) -> None:
+        """Back-fill a lazily-judged sentinel verdict into the record
+        covering ``iteration`` (the fused path judges its in-program
+        flag words iterations after the step dispatched). ``flags`` is
+        the judged word: 0 = clean."""
+        verdict = "ok" if not flags else f"flags=0b{int(flags):05b}"
+        with self._lock:
+            for rec in reversed(self._ring):
+                if rec["type"] != "iter":
+                    continue
+                if rec["iteration"] <= iteration \
+                        < rec["iteration"] + max(rec["iters"], 1):
+                    rec["sentinel"] = verdict
+                    return
+
+    def records(self) -> List[dict]:
+        """Current ring contents (oldest first; copies)."""
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    # --------------------------------------------------------- flushing
+    @property
+    def _filename(self) -> str:
+        if self.incarnation > 0:
+            return f"flight_rank{self.rank}.r{self.incarnation}.jsonl"
+        return f"flight_rank{self.rank}.jsonl"
+
+    def _resolve_path(self) -> str:
+        d = self.directory
+        if not d:
+            # event flushes must land SOMEWHERE even when no durable dir
+            # was configured — a temp dir beats losing the post-mortem
+            import tempfile
+            d = tempfile.mkdtemp(prefix="lgbm_flight_")
+            self.directory = d
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, self._filename)
+
+    def path(self) -> Optional[str]:
+        """Where this recorder flushes (None until a directory is known
+        — i.e. configured, or created by the first event flush)."""
+        if self._last_path:
+            return self._last_path
+        if self.directory:
+            return os.path.join(self.directory, self._filename)
+        return None
+
+    def flush(self, reason: str, retain_event: bool = True) -> Optional[str]:
+        """Write header + ring + flush events to the JSONL atomically
+        and return the path (best-effort: a flush must never turn a
+        crash diagnosis into a crash of its own — on failure it warns
+        and returns None). Each flush appends its own event record
+        first, carrying the reason and the health/scope state at flush
+        time, so the LAST line of the file names what killed the run
+        and which iteration was in flight. ``retain_event=False``
+        (periodic checkpoint flushes) writes the event into THIS file
+        but does not keep it for later flushes — retained events are
+        the rare diagnostic ones (bounded at 64, oldest dropped)."""
+        from . import distributed
+        from .utils import profiling
+        from .utils.atomic_write import atomic_write_text
+        try:
+            health = distributed.health_snapshot()
+        except Exception:
+            health = {}
+        event = {"type": "flush", "t": _utcnow(), "reason": str(reason),
+                 "health": health, "scopes": profiling.scopes(),
+                 "gauges": profiling.gauges(),
+                 "dispatch": profiling.dispatch_stats()}
+        try:
+            # the WHOLE flush — event append, directory resolution (which
+            # may create the fallback temp dir), write, _last_path — runs
+            # under the lock: the watchdog thread and the training
+            # thread's error flush fire together by design, and racing
+            # _resolve_path would mint two temp dirs and split the
+            # post-mortem across divergent files
+            with self._lock:
+                if retain_event:
+                    self._flushes.append(event)
+                header = {"type": "run", "schema": SCHEMA_VERSION,
+                          "rank": self.rank, "pid": os.getpid(),
+                          "capacity": self.capacity,
+                          "context": dict(self._context)}
+                lines = [header] + [dict(r) for r in self._ring] \
+                    + [dict(f) for f in self._flushes]
+                if not retain_event:
+                    lines.append(event)
+                path = self._resolve_path()
+                atomic_write_text(path, "\n".join(
+                    json.dumps(r, sort_keys=True, default=str)
+                    for r in lines) + "\n")
+                self._last_path = path
+            return path
+        except Exception as e:       # noqa: BLE001 — see docstring
+            try:
+                log.warning(f"flight recorder flush failed ({reason}): {e}")
+            except Exception:
+                pass
+            return None
+
+
+# process-level recorder: ONE per process (the training plane is
+# process-wide — heartbeats, watchdog, degradation log all are), rebuilt
+# by configure() whenever a new training run initializes
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def configure(config=None) -> Optional[FlightRecorder]:
+    """(Re)build the process flight recorder from config — called by
+    ``GBDT._init_train`` so every training run starts with a fresh ring
+    (like ``distributed.reset_degradations``). Returns the recorder, or
+    None (and clears any previous one) when
+    ``telemetry_flight_recorder`` is off.
+
+    Flush directory resolution: explicit ``telemetry_dir`` param > the
+    supervisor's diag-dir env (supervised gang children inherit it, so
+    their post-mortems land next to the watchdog/divergence diagnoses)
+    > ``checkpoint_path``/telemetry > none (event flushes then fall
+    back to a temp dir)."""
+    global _recorder
+    get = (lambda k, d: getattr(config, k, d)) if config is not None \
+        else (lambda k, d: d)
+    if not bool(get("telemetry_flight_recorder", True)):
+        with _recorder_lock:
+            _recorder = None
+        return None
+    from . import distributed
+    directory = str(get("telemetry_dir", "") or "")
+    if not directory:
+        directory = os.environ.get(distributed._DIAG_DIR_ENV, "") or ""
+    if not directory:
+        ck = str(get("checkpoint_path", "") or "")
+        if ck:
+            directory = os.path.join(ck, "telemetry")
+    rec = FlightRecorder(
+        capacity=int(get("telemetry_ring_size", 256)),
+        directory=directory or None,
+        rank=distributed.jax_rank(),
+        flush_period=int(get("telemetry_flush_period", 64)),
+        incarnation=int(os.environ.get(distributed._RESTART_COUNT_ENV,
+                                       "0") or 0))
+    with _recorder_lock:
+        _recorder = rec
+    return rec
+
+
+def recorder() -> Optional[FlightRecorder]:
+    """The live process recorder (None when disabled/never configured)."""
+    return _recorder
+
+
+def recorder_path() -> Optional[str]:
+    """The live recorder's JSONL path, for embedding BY REFERENCE in
+    health snapshots, checkpoint manifests and watchdog/divergence
+    diagnoses. None when no recorder is live or no directory is known
+    yet."""
+    rec = _recorder
+    return rec.path() if rec is not None else None
+
+
+def flush_recorder(reason: str) -> Optional[str]:
+    """Flush the process recorder (no-op None when there isn't one).
+    For CONTEXT-FREE event paths only — the watchdog thread, the
+    divergence verdict, ``faults._hard_exit`` — which have no booster
+    in hand; booster-scoped paths (engine train-error/train-end, the
+    OOM ladder) flush ``GBDT._flight`` directly so a multi-booster
+    process (cv folds, bench probes) never flushes the wrong ring."""
+    rec = _recorder
+    if rec is None:
+        return None
+    return rec.flush(reason)
+
+
+# ------------------------------------------------- JSONL validation
+
+def validate_flight_record(rec: Dict[str, Any]) -> List[str]:
+    """Schema-check one flight-recorder record; returns the list of
+    violations (empty = valid)."""
+    errs = []
+    rtype = rec.get("type")
+    if rtype not in FLIGHT_RECORD_FIELDS:
+        return [f"unknown record type {rtype!r}"]
+    for f in FLIGHT_RECORD_FIELDS[rtype]:
+        if f not in rec:
+            errs.append(f"{rtype} record missing field {f!r}")
+    if rtype == "run" and rec.get("schema") != SCHEMA_VERSION:
+        errs.append(f"schema {rec.get('schema')!r} != {SCHEMA_VERSION}")
+    return errs
+
+
+def validate_flight_jsonl(path: str):
+    """Parse + schema-validate a flushed flight-recorder JSONL. Returns
+    ``(records, errors)``; a valid file has a ``run`` header first, at
+    least one ``flush`` event, and no per-record violations."""
+    records: List[dict] = []
+    errors: List[str] = []
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errors.append(f"line {i + 1}: unparseable JSON ({e})")
+                continue
+            errors.extend(f"line {i + 1}: {m}"
+                          for m in validate_flight_record(rec))
+            records.append(rec)
+    if not records or records[0].get("type") != "run":
+        errors.append("first record is not a 'run' header")
+    if not any(r.get("type") == "flush" for r in records):
+        errors.append("no 'flush' event record")
+    return records, errors
+
+
+# ==================================================== trace capture
+
+class TraceResult:
+    """Outcome of a :func:`trace_window` capture."""
+
+    def __init__(self, trace_dir: str, iters: Optional[int]):
+        self.trace_dir = trace_dir
+        self.iters = iters
+        self.ok = False
+        self.error: Optional[str] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"dir": self.trace_dir, "iters": self.iters,
+                "ok": self.ok, "error": self.error}
+
+
+@contextmanager
+def trace_window(trace_dir: str,
+                 iters: Optional[int] = None) -> Iterator[TraceResult]:
+    """Capture a device trace around a window of boosting iterations::
+
+        with telemetry.trace_window(d, iters=N) as tw:
+            for _ in range(N):
+                booster.update()
+
+    Drives ``jax.profiler.start_trace``/``stop_trace``; the
+    ``TraceAnnotation`` scopes ``profiling.timer`` opens mean the
+    grower phases (hist_pass / split_search / apply_split under TIMETAG,
+    grow_tree/score_update always) arrive labeled in the perfetto trace
+    for free. ``iters`` is metadata recorded in the result (bench.py
+    writes it into the BENCH JSON).
+
+    Tolerant by design: a backend whose profiler cannot start (or a
+    wedged stop) records ``tw.error`` instead of raising — trace
+    capture is measurement, and measurement must never kill the run
+    being measured. ``tw.ok`` is True only when both start and stop
+    succeeded."""
+    tw = TraceResult(trace_dir, iters)
+    import jax
+    started = False
+    try:
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        started = True
+    except Exception as e:       # noqa: BLE001 — tolerance contract above
+        tw.error = f"start_trace failed: {e}"
+        log.warning(f"trace_window: {tw.error}")
+    try:
+        yield tw
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                tw.ok = True
+            except Exception as e:   # noqa: BLE001
+                tw.error = f"stop_trace failed: {e}"
+                log.warning(f"trace_window: {tw.error}")
+
+
+def trace_files(trace_dir: str) -> List[str]:
+    """Trace artifacts under a capture directory (the ``.pb``/
+    ``.json.gz`` event files jax's profiler writes) — what the smoke
+    test asserts non-empty to call a capture loadable."""
+    out = []
+    for root, _dirs, files in os.walk(trace_dir):
+        for f in files:
+            if f.endswith((".pb", ".json.gz", ".trace.json.gz", ".xplane.pb")):
+                out.append(os.path.join(root, f))
+    return sorted(out)

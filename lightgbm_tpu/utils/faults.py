@@ -228,7 +228,20 @@ def _process_rank() -> int:
 
 def _hard_exit(context: str) -> None:
     """``os._exit`` skips atexit/finally so nothing gets the chance to
-    'finish' a write (the SIGKILL shape a preempted worker actually sees)."""
+    'finish' a write (the SIGKILL shape a preempted worker actually sees).
+
+    One deliberate exception: the flight recorder flushes first. A real
+    SIGKILL cannot flush anything — for that shape, durable-dir runs
+    rely on the recorder's periodic flush — but the harness kill is the
+    TESTABLE stand-in for preemption, and the whole point of the
+    post-mortem ring is that a killed gang leaves one; the flush is a
+    single atomic file write, so it cannot 'finish' any in-flight
+    checkpoint the way skipping atexit is meant to prevent."""
+    try:
+        from .. import telemetry
+        telemetry.flush_recorder(f"fault-kill {context}")
+    except Exception:
+        pass
     sys.stderr.write(f"[faults] killing process {context}\n")
     sys.stderr.flush()
     os._exit(_KILL_EXIT_CODE)

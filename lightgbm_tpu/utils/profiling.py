@@ -16,12 +16,21 @@ like USE_TIMETAG, profiling adds overhead.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from typing import Dict, Iterator, Optional
 
 _enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+# One lock over every aggregate table below. The scopes/counters used to
+# be bare defaultdict read-modify-writes, which was fine while only the
+# training thread touched them — but the serve dispatcher thread, the
+# watchdog thread and the flight recorder all read/update these now, and
+# a racing `_acc[k] += v` can lose an update (the read and the store are
+# separate bytecodes). RLock because table()/scopes() may be called from
+# a flush that already holds it via the recorder.
+_lock = threading.RLock()
 _acc: Dict[str, float] = defaultdict(float)
 _cnt: Dict[str, int] = defaultdict(int)
 # named value counters (work counts rather than wall time): the analog of
@@ -41,11 +50,20 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    _acc.clear()
-    _cnt.clear()
-    _counters.clear()
-    _counter_cnt.clear()
-    _gauges.clear()
+    """Clear the timer scopes, work counters and gauges.
+
+    Deliberately does NOT touch the dispatch/transfer counters
+    (``_disp``): those are MONOTONIC by contract — concurrent readers
+    scope their measurements by diffing two ``dispatch_stats()``
+    snapshots, and a reset between their snapshots would corrupt every
+    in-flight delta. Tests that need a clean origin use
+    :func:`reset_dispatch` (nothing else may)."""
+    with _lock:
+        _acc.clear()
+        _cnt.clear()
+        _counters.clear()
+        _counter_cnt.clear()
+        _gauges.clear()
 
 
 def counter(name: str, value: float) -> None:
@@ -54,23 +72,27 @@ def counter(name: str, value: float) -> None:
     device sync just to record one (fetch an already-synced value)."""
     if not _enabled:
         return
-    _counters[name] += float(value)
-    _counter_cnt[name] += 1
+    with _lock:
+        _counters[name] += float(value)
+        _counter_cnt[name] += 1
 
 
 def counters() -> Dict[str, float]:
     """Accumulated named counters (empty when profiling is disabled)."""
-    return dict(_counters)
+    with _lock:
+        return dict(_counters)
 
 
 def scopes() -> Dict[str, Dict[str, float]]:
     """Accumulated timer scopes as data: ``{name: {"total_s", "calls",
     "mean_ms"}}`` — what ``table()`` prints, machine-readable (bench.py's
-    phase sub-scope probe reads hist_pass / split_search / apply_split
-    out of this for the BENCH JSON ``phases`` dict)."""
-    return {name: {"total_s": _acc[name], "calls": _cnt[name],
-                   "mean_ms": 1e3 * _acc[name] / max(_cnt[name], 1)}
-            for name in _acc}
+    phase sub-scope probe and the flight recorder's per-iteration phase
+    deltas both read hist_pass / split_search / apply_split out of
+    this)."""
+    with _lock:
+        return {name: {"total_s": _acc[name], "calls": _cnt[name],
+                       "mean_ms": 1e3 * _acc[name] / max(_cnt[name], 1)}
+                for name in _acc}
 
 
 # Health gauges: last-value-wins instruments (heartbeat age, supervisor
@@ -82,23 +104,45 @@ _gauges: Dict[str, float] = {}
 
 def set_gauge(name: str, value: float) -> None:
     """Record the current value of a named health gauge."""
-    _gauges[name] = float(value)
+    with _lock:
+        _gauges[name] = float(value)
 
 
 def inc_gauge(name: str, delta: float = 1.0) -> float:
     """Increment a counting gauge (serve shed/timeout counts) and return
-    the new value. Single dict read-modify-write under the GIL — racing
-    increments from serve caller threads can in principle lose a count,
-    which is acceptable for health telemetry (the authoritative counts
-    live on the ServeFrontend, behind its lock)."""
-    v = _gauges.get(name, 0.0) + float(delta)
-    _gauges[name] = v
-    return v
+    the new value. Runs under the module lock, so racing increments from
+    serve caller threads no longer lose counts (the authoritative counts
+    still live on the ServeFrontend, behind its own lock — these gauges
+    mirror them into health snapshots)."""
+    with _lock:
+        v = _gauges.get(name, 0.0) + float(delta)
+        _gauges[name] = v
+        return v
 
 
 def gauges() -> Dict[str, float]:
     """Current gauge values (supervisor restarts, heartbeat ages, ...)."""
-    return dict(_gauges)
+    with _lock:
+        return dict(_gauges)
+
+
+def _sync_fetch(value) -> None:
+    """Block on ``value`` (an array or pytree) and fetch one scalar of it
+    — the scope-exit barrier both ``timer`` and ``timer_sync`` use so a
+    measured scope covers the device work dispatched inside it. A host
+    fetch is the only reliable barrier through some TPU tunnels, hence
+    the scalar read on top of block_until_ready. Best-effort: a failed
+    fetch must not fail the scope."""
+    if value is None:
+        return
+    import jax
+    try:
+        jax.block_until_ready(value)
+        leaves = jax.tree_util.tree_leaves(value)
+        if leaves:
+            _ = float(leaves[0].ravel()[0])
+    except Exception:
+        pass
 
 
 @contextmanager
@@ -115,18 +159,10 @@ def timer(name: str, sync=None) -> Iterator[None]:
         try:
             yield
         finally:
-            if sync is not None:
-                try:
-                    jax.block_until_ready(sync)
-                    # a host fetch is the only reliable barrier through some
-                    # TPU tunnels; fetch one scalar
-                    leaves = jax.tree_util.tree_leaves(sync)
-                    if leaves:
-                        _ = float(leaves[0].ravel()[0])
-                except Exception:
-                    pass
-            _acc[name] += time.time() - t0
-            _cnt[name] += 1
+            _sync_fetch(sync)
+            with _lock:
+                _acc[name] += time.time() - t0
+                _cnt[name] += 1
 
 
 class timer_sync:
@@ -143,19 +179,13 @@ class timer_sync:
     def __enter__(self):
         self._cm = timer(self.name, None)
         self._cm.__enter__()
-        self._t0 = time.time()
         return self
 
     def __exit__(self, *exc):
-        if _enabled and self._sync is not None:
-            import jax
-            try:
-                jax.block_until_ready(self._sync)
-                leaves = jax.tree_util.tree_leaves(self._sync)
-                if leaves:
-                    _ = float(leaves[0].ravel()[0])
-            except Exception:
-                pass
+        # the fetch happens BEFORE the inner timer closes, so the scope's
+        # recorded wall time covers the synced device work
+        if _enabled:
+            _sync_fetch(self._sync)
         return self._cm.__exit__(*exc)
 
 
@@ -206,19 +236,26 @@ def install_dispatch_hook() -> bool:
         orig_call = pxla.ExecuteReplicated.__call__
 
         def _counting_call(self, *args):
-            _disp["dispatches"] += 1
+            # locked like the other aggregates: concurrent dispatches
+            # (serve threads + training) must not lose increments — the
+            # dispatch-budget assertions diff these counters
+            with _lock:
+                _disp["dispatches"] += 1
             return orig_call(self, *args)
 
         orig_get = jax.device_get
 
         def _counting_get(x):
-            _disp["device_gets"] += 1
+            bytes_ = 0
             try:
                 for leaf in jax.tree_util.tree_leaves(x):
                     if isinstance(leaf, jax.Array):
-                        _disp["d2h_bytes"] += int(leaf.nbytes)
+                        bytes_ += int(leaf.nbytes)
             except Exception:
                 pass
+            with _lock:
+                _disp["device_gets"] += 1
+                _disp["d2h_bytes"] += bytes_
             return orig_get(x)
 
         orig_bdp = pxla.batched_device_put
@@ -229,9 +266,10 @@ def install_dispatch_hook() -> bool:
             # signature drift degrades the counter, never the upload
             try:
                 xs = kwargs.get("xs", args[2] if len(args) > 2 else ())
-                _disp["h2d_bytes"] += sum(
-                    int(getattr(x, "nbytes", 0)) for x in xs
-                    if not isinstance(x, jax.Array))
+                bytes_ = sum(int(getattr(x, "nbytes", 0)) for x in xs
+                             if not isinstance(x, jax.Array))
+                with _lock:
+                    _disp["h2d_bytes"] += bytes_
             except Exception:
                 pass
             return orig_bdp(*args, **kwargs)
@@ -293,10 +331,22 @@ def uninstall_dispatch_hook() -> None:
 
 def dispatch_stats() -> Dict[str, int]:
     """Current cumulative counter values (all zero until
-    ``install_dispatch_hook`` succeeds). Monotonic — diff two snapshots to
-    scope a measurement (no reset, so concurrent readers never clobber
-    each other)."""
-    return dict(_disp)
+    ``install_dispatch_hook`` succeeds). Monotonic BY CONTRACT — diff two
+    snapshots to scope a measurement; ``reset()`` deliberately leaves
+    these alone so concurrent readers' deltas never get clobbered. Tests
+    that need a clean origin use :func:`reset_dispatch`."""
+    with _lock:
+        return dict(_disp)
+
+
+def reset_dispatch() -> None:
+    """Zero the dispatch/transfer counters. FOR TESTS ONLY: library and
+    measurement code must scope with ``dispatch_stats()`` deltas instead
+    (``reset()`` keeps these monotonic by contract) — zeroing while any
+    other reader holds a snapshot corrupts that reader's delta."""
+    with _lock:
+        for k in _disp:
+            _disp[k] = 0
 
 
 def dispatch_delta(before: Dict[str, int],
@@ -326,6 +376,11 @@ def table() -> str:
     """Aggregated per-scope wall-time table (reference: the USE_TIMETAG
     summary printed by ~Timer, common.h:970-990), followed by the named
     work counters."""
+    with _lock:
+        return _table_locked()
+
+
+def _table_locked() -> str:
     if not _acc and not _counters:
         return "(no timer scopes recorded)"
     lines = []

@@ -39,6 +39,14 @@ python -u "$(dirname "$0")/../scripts/compile_wall_smoke.py" || fail=1
 # candidate swaps in bit-identical to a cold load
 echo "=== scripts/serve_smoke.py"
 python -u "$(dirname "$0")/../scripts/serve_smoke.py" || fail=1
+# telemetry smoke (fast knobs, ~20 s on CPU): kill-at-iteration flushes
+# a flight-recorder JSONL that schema-validates and names the in-flight
+# iteration; a clean run flushes at train end with the health snapshot
+# referencing the JSONL; a trace_window capture around two boosting
+# iterations writes perfetto artifacts (or records the profiler error —
+# jax.profiler no-op tolerance); the Prometheus exposition renders
+echo "=== scripts/telemetry_smoke.py"
+python -u "$(dirname "$0")/../scripts/telemetry_smoke.py" || fail=1
 # serve bench smoke (fast knobs, ~15 s on CPU): open-loop mixed-size load
 # through the micro-batching frontend; asserts it completes and reports
 # serve_p50_ms / serve_p99_ms / serve_rows_per_sec / serve_shed_count JSON

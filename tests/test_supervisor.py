@@ -149,6 +149,22 @@ def test_gang_kill_rank_mid_iter_bit_identical(tmp_path):
     # bench.py health JSON reads it)
     from lightgbm_tpu.utils import profiling
     assert profiling.gauges().get("supervisor_restarts") == 1.0
+    # flight recorder (telemetry acceptance): the killed rank flushed
+    # its per-iteration ring into the diag dir before os._exit — the
+    # JSONL validates and its last record names the in-flight iteration
+    # with phase/health state (the relaunched incarnation writes
+    # .r1.jsonl files, so the post-mortem survives the restart)
+    from lightgbm_tpu import telemetry
+    flight = os.path.join(ckdir, "supervisor_diag", "flight_rank1.jsonl")
+    assert os.path.exists(flight), "killed rank left no flight recorder"
+    recs, errors = telemetry.validate_flight_jsonl(flight)
+    assert errors == []
+    flush = recs[-1]
+    assert flush["type"] == "flush"
+    assert "at iteration 3" in flush["reason"]
+    assert flush["health"]["last_iteration"] == 2
+    iters = [r for r in recs if r["type"] == "iter"]
+    assert iters and iters[-1]["iteration"] == 2
 
 
 @pytest.mark.slow
@@ -176,6 +192,14 @@ def test_gang_hang_rank_watchdog_fires_bit_identical(tmp_path):
     assert d["suspects"] == [1]
     assert d["iteration"] >= 1          # completed iters before the stall
     assert d["deadline"] == GANG_PARAMS["collective_deadline"]
+    # the diagnosis references the firing rank's flushed flight-recorder
+    # JSONL (telemetry.py): stall verdict + per-iteration post-mortem
+    # travel together through the supervisor report
+    assert d.get("flight_recorder"), "diagnosis lacks flight_recorder ref"
+    assert report.failures[0].flight_recorders
+    from lightgbm_tpu import telemetry
+    _, errors = telemetry.validate_flight_jsonl(d["flight_recorder"])
+    assert errors == []
     assert report.result == clean
 
 
