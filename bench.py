@@ -125,6 +125,113 @@ def _warm_child(cfg):
         "warm_cache_misses": _compile_totals()["misses"]}))
 
 
+def higgs_weights(features, seed=0):
+    """The label weight vector every Higgs-shaped datagen site shares —
+    ONE definition so the --streaming train stream, its held-out valid
+    rows and the monolithic branch stay the same task (a drifted copy
+    would silently turn the AUC anchor into a mismatched-distribution
+    measurement)."""
+    import numpy as np
+    return np.random.RandomState(seed).normal(size=features)
+
+
+def higgs_logits(X, w):
+    """Higgs-shaped label logits for feature matrix ``X`` under weight
+    vector ``w`` (see higgs_weights)."""
+    import numpy as np
+    f = X.shape[1]
+    return (X[:, : f // 2] @ w[: f // 2]
+            + 0.5 * np.sin(X[:, f // 2]) * X[:, 0])
+
+
+def higgs_chunk_stream(rows, features, chunk_rows, seed=0):
+    """Chunked Higgs-shaped datagen: a callable chunk factory yielding
+    ``(X_chunk, y_chunk)`` pairs, each generated from its own per-chunk
+    RandomState — so the 100M-shape round NEVER holds the raw ``[N, F]``
+    matrix in host RAM (the monolithic datagen's 11.8 GB at 100M x 28 f32
+    was the other half of the construct ceiling, next to construct
+    itself). The label weight vector is seed-deterministic and shared
+    across chunks, so the stream is re-iterable (the two construct
+    passes) and reproducible."""
+    import numpy as np
+    w = higgs_weights(features, seed)
+
+    def factory():
+        for ci, s in enumerate(range(0, rows, chunk_rows)):
+            n = min(chunk_rows, rows - s)
+            rng = np.random.RandomState((seed + 1) * 100003 + ci)
+            X = rng.normal(size=(n, features)).astype(np.float32)
+            y = (higgs_logits(X, w) + rng.logistic(size=n) > 0) \
+                .astype(np.float32)
+            yield X, y
+
+    return factory
+
+
+def construct_probe(rows, args):
+    """Streaming-vs-monolithic construct at CPU-diagnostic scale: the
+    SAME float32 matrix constructed both ways, reporting wall seconds,
+    rows/sec, the streaming path's peak resident raw-chunk bytes and its
+    sketch/bin/h2d sub-phases (telemetry.construct_snapshot), plus a
+    bit-parity verdict over the resulting bin matrices — the
+    chunked-ingest acceptance numbers on every backend."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import telemetry
+
+    n = min(rows, 500_000)
+    f = args.features
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    chunk_rows = max(1, n // 8)
+
+    # bit-parity preconditions: the sampled monolithic fit equals the
+    # all-rows sketch fit only when (a) the sample covers every row and
+    # (b) the sketch never compacts — so the probe pins
+    # bin_construct_sample_cnt >= n AND sketch_max_size=0 (exact mode) on
+    # both sides; without them a >=200k-row probe reports a FALSE parity
+    # failure (sampling) or a >=65k-distinct one does (compaction). The
+    # compaction regime's quality is covered by the rank-error tests,
+    # not this bit-parity probe.
+    common = {"max_bin": args.max_bin, "verbosity": -1,
+              "bin_construct_sample_cnt": n, "sketch_max_size": 0}
+    t0 = time.time()
+    ds_m = lgb.Dataset(X, params=dict(common)).construct()
+    import jax
+    jax.block_until_ready(ds_m.bins)
+    mono_sec = time.time() - t0
+
+    t0 = time.time()
+    ds_s = lgb.Dataset(X, params={**common,
+                                  "construct_chunk_rows": chunk_rows})
+    ds_s.construct(streaming=True)
+    stream_sec = time.time() - t0
+    parity = bool(np.array_equal(np.asarray(ds_m.bins),
+                                 np.asarray(ds_s.bins)))
+    snap = telemetry.construct_snapshot()
+    peak = snap.get("peak_host_bytes")
+    return {
+        "construct_probe_rows": n,
+        "construct_monolithic_sec": round(mono_sec, 3),
+        "construct_streaming_sec": round(stream_sec, 3),
+        "construct_streaming_rows_per_sec": round(n / max(stream_sec, 1e-9),
+                                                  1),
+        # probe-scoped key: the MAIN run's construct_peak_host_bytes
+        # (the 100M acceptance number on --streaming rounds) must not be
+        # clobbered by this diagnostic-scale probe's result.update
+        "construct_probe_peak_host_bytes": peak,
+        # the acceptance ratio: peak resident raw bytes over ONE chunk's
+        # bytes — must stay <= 2 (current chunk + in-flight padded copy),
+        # vs the monolithic path's n/chunk_rows chunks resident
+        "construct_peak_chunks": (round(peak / (chunk_rows * f * 4), 2)
+                                  if peak else None),
+        "construct_bins_bit_identical": parity,
+        "construct_phases": {k: snap[k] for k in
+                             ("sketch_pass", "bin_pass", "h2d_overlap")
+                             if k in snap},
+    }
+
+
 def _telemetry_json():
     """The unified telemetry snapshot for the result JSON
     (telemetry.snapshot(): scopes + counters + gauges + dispatch +
@@ -176,22 +283,52 @@ def run_at_scale(rows, args, hist_method="auto", hist_compaction=True,
     # train + held-out valid rows from the same synthetic distribution
     n_valid = min(args.valid_rows, rows // 10)
     n, f = rows, args.features
+    streaming = bool(getattr(args, "streaming", False))
     t0 = time.time()
-    # Higgs-shaped synthetic: continuous physics-like features, binary label
-    X = rng.normal(size=(n + n_valid, f)).astype(np.float32)
-    w = rng.normal(size=f)
-    logits = X[:, : f // 2] @ w[: f // 2] + 0.5 * np.sin(X[:, f // 2]) * X[:, 0]
-    y = (logits + rng.logistic(size=n + n_valid) > 0).astype(np.float32)
-    Xv, yv = X[n:], y[n:]
-    X, y = X[:n], y[:n]
-    phases["datagen"] = time.time() - t0
-    mark("datagen")
-
-    t0 = time.time()
-    ds = lgb.Dataset(X, label=y, params={"max_bin": args.max_bin,
-                                         "verbosity": -1})
-    ds.construct()
+    if streaming:
+        # chunked datagen + streaming construct: the raw [N, F] train
+        # matrix NEVER materializes — each chunk is generated, sketched
+        # and device-binned in O(chunk) host memory (the 100M-row shape's
+        # only viable ingest). The held-out rows stay monolithic (small).
+        chunk_rows = int(getattr(args, "construct_chunk_rows", 0) or 0) \
+            or min(max(1 << 18, n // 8), 1 << 21)
+        factory = higgs_chunk_stream(n, f, chunk_rows, seed=0)
+        vr = np.random.RandomState(10**6)
+        Xv = vr.normal(size=(n_valid, f)).astype(np.float32)
+        yv = (higgs_logits(Xv, higgs_weights(f, 0))
+              + vr.logistic(size=n_valid) > 0).astype(np.float32)
+        phases["datagen"] = time.time() - t0
+        mark("datagen (chunked stream)")
+        t0 = time.time()
+        ds = lgb.Dataset.from_chunks(
+            factory, params={"max_bin": args.max_bin, "verbosity": -1,
+                             "construct_chunk_rows": chunk_rows})
+        ds.construct()
+    else:
+        # Higgs-shaped synthetic: continuous physics-like features,
+        # binary label. NOTE: w here is drawn AFTER X on this rng's
+        # stream (the historical monolithic task, kept for round-over-
+        # round comparability), so it is a DIFFERENT weight realization
+        # than the streaming branch's higgs_weights(f, 0) — compare AUC
+        # within a mode across rounds, not across modes
+        X = rng.normal(size=(n + n_valid, f)).astype(np.float32)
+        w = rng.normal(size=f)
+        y = (higgs_logits(X, w)
+             + rng.logistic(size=n + n_valid) > 0).astype(np.float32)
+        Xv, yv = X[n:], y[n:]
+        X, y = X[:n], y[:n]
+        phases["datagen"] = time.time() - t0
+        mark("datagen")
+        t0 = time.time()
+        ds = lgb.Dataset(X, label=y, params={"max_bin": args.max_bin,
+                                             "verbosity": -1})
+        ds.construct()
     phases["construct"] = time.time() - t0
+    if streaming:
+        from lightgbm_tpu import telemetry as _telemetry
+        for k, v in _telemetry.construct_snapshot().items():
+            if k in ("sketch_pass", "bin_pass", "h2d_overlap"):
+                phases[k] = v
     mark("construct")
 
     booster = lgb.Booster(params={
@@ -452,6 +589,17 @@ def main():
     ap.add_argument("--probe-timeout", type=int, default=180,
                     help="hard deadline (s) on the TPU backend-init probe "
                          "subprocess before falling back to CPU")
+    ap.add_argument("--streaming", action="store_true",
+                    help="chunked datagen + streaming two-pass construct "
+                         "for the MAIN run: the raw [N, F] train matrix "
+                         "never materializes in host RAM (required for "
+                         "the 100M-row Higgs-shape round; host memory "
+                         "stays O(chunk))")
+    ap.add_argument("--construct-chunk-rows", type=int, default=0,
+                    dest="construct_chunk_rows",
+                    help="rows per construct chunk in --streaming mode "
+                         "(0 = auto: n/8 clamped to [262144, 2M], so any "
+                         "scale above ~262k rows streams multi-chunk)")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
     ap.add_argument("--require-tpu", action="store_true", dest="require_tpu",
                     help="fail LOUDLY (exit 2, error JSON with "
@@ -618,6 +766,8 @@ def main():
           f"f32-peak {mfu:.4f} / bf16-peak {mfu_d['mfu_bf16']:.4f} / "
           f"mode-peak {mfu_d['mfu_mode']:.4f}", file=sys.stderr)
 
+    from lightgbm_tpu.utils import profiling as _profiling
+    profiling_gauges = _profiling.gauges()
     result = {
         "metric": f"higgs{used_rows/1e6:.1f}M_sec_per_iter",
         "value": round(sec_per_iter, 4),
@@ -667,6 +817,19 @@ def main():
         # (XLA compile + first block), the K-block shape, and this
         # process's persistent-cache counters; the warm_start_s probe
         # below supplies the second-process (cache-hit) side of the delta
+        # construct-phase telemetry (the chunked-ingest tentpole): wall
+        # seconds, throughput, and — on --streaming runs — the peak
+        # resident raw-chunk bytes (O(chunk), vs O(N*F) monolithic); the
+        # streaming-vs-monolithic probe below supplies the comparison
+        # fields at diagnostic scale on every backend
+        "construct_sec": round(phases.get("construct", 0.0), 3),
+        "construct_rows_per_sec": round(
+            used_rows / max(phases.get("construct", 0.0), 1e-9), 1),
+        "construct_streaming": bool(getattr(args, "streaming", False)),
+        "construct_peak_host_bytes": (
+            int(profiling_gauges.get("construct_peak_bytes"))
+            if profiling_gauges.get("construct_peak_bytes") is not None
+            else None),
         "first_iter_compile_s": round(
             phases.get("first_iter_incl_compile", 0.0), 3),
         "trees_per_dispatch": round(trees_per_dispatch, 2)
@@ -714,6 +877,26 @@ def main():
         except Exception:
             traceback.print_exc(file=sys.stderr)
             print("# phase-scope probe failed; omitting", file=sys.stderr)
+    print(json.dumps(result), flush=True)
+
+    # streaming-vs-monolithic construct probe (runs on ANY backend at
+    # CPU-diagnostic scale): the same matrix constructed both ways —
+    # wall seconds, the streaming path's peak resident raw-chunk bytes
+    # (acceptance: <= 2 chunks) and a bin-matrix bit-parity verdict
+    if probe_headroom("construct"):
+        try:
+            cp = construct_probe(used_rows, args)
+            result.update(cp)
+            print(f"# construct probe: monolithic "
+                  f"{cp['construct_monolithic_sec']}s vs streaming "
+                  f"{cp['construct_streaming_sec']}s at "
+                  f"{cp['construct_probe_rows']} rows, peak "
+                  f"{cp['construct_peak_chunks']} chunks resident, "
+                  f"bit-identical={cp['construct_bins_bit_identical']}",
+                  file=sys.stderr)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            print("# construct probe failed; omitting", file=sys.stderr)
     print(json.dumps(result), flush=True)
 
     # compaction on/off headroom probe (runs on ANY backend — the row

@@ -281,6 +281,35 @@ class BinMapper:
         na_mask = np.isnan(values)
         na_cnt = int(na_mask.sum())
         values = values[~na_mask]
+        if len(values):
+            vals, counts = np.unique(values, return_counts=True)
+        else:
+            vals, counts = np.array([]), np.array([], dtype=np.int64)
+        self.find_bin_from_distinct(
+            vals, counts, na_cnt, total_sample_cnt, max_bin,
+            min_data_in_bin=min_data_in_bin, min_split_data=min_split_data,
+            pre_filter=pre_filter, bin_type=bin_type, use_missing=use_missing,
+            zero_as_missing=zero_as_missing, forced_bounds=forced_bounds)
+
+    def find_bin_from_distinct(self, vals: np.ndarray, counts: np.ndarray,
+                               na_cnt: int, total_sample_cnt: int,
+                               max_bin: int, min_data_in_bin: int = 3,
+                               min_split_data: int = 0,
+                               pre_filter: bool = False,
+                               bin_type: int = BIN_TYPE_NUMERICAL,
+                               use_missing: bool = True,
+                               zero_as_missing: bool = False,
+                               forced_bounds: Optional[Sequence[float]] = None
+                               ) -> None:
+        """Fit from a pre-aggregated (sorted distinct values, counts, NaN
+        count) summary — the form a streaming :class:`FeatureSketch` holds,
+        and exactly what ``find_bin`` computes internally, so a sketch that
+        never compacted fits BIT-IDENTICAL mappers to the sampled path.
+        ``total_sample_cnt - counts.sum() - na_cnt`` rows are implied zeros
+        (the sparse sampling protocol)."""
+        vals = np.asarray(vals, dtype=np.float64)
+        counts = np.asarray(counts, dtype=np.int64)
+        na_cnt = int(na_cnt)
 
         if not use_missing:
             self.missing_type = MISSING_NONE
@@ -291,14 +320,10 @@ class BinMapper:
 
         self.bin_type = bin_type
         self.default_bin = 0
-        zero_cnt = int(total_sample_cnt - len(values) - na_cnt)
+        zero_cnt = int(total_sample_cnt - counts.sum() - na_cnt)
 
         # distinct values with counts; zero slot positioned in sorted order
         # (reference: bin.cpp:355-395)
-        if len(values):
-            vals, counts = np.unique(values, return_counts=True)
-        else:
-            vals, counts = np.array([]), np.array([], dtype=np.int64)
         if zero_cnt > 0 or len(vals) == 0:
             if 0.0 not in vals:
                 insert_at = int(np.searchsorted(vals, 0.0))
@@ -478,6 +503,27 @@ def sample_indices(num_data: int, sample_cnt: int, seed: int) -> np.ndarray:
     return np.sort(rng.choice(num_data, size=sample_cnt, replace=False))
 
 
+def _find_bin_kwargs(j: int, config, cat_set, filter_cnt: int,
+                     forced_bounds=None) -> dict:
+    """Per-column binning parameters from the config — the ONE kwargs
+    assembly both the sampled (``fit_mapper_for_column``) and the
+    streaming (``fit_mappers_from_sketches``) fits use, so a new binning
+    parameter cannot reach one construct path and miss the other (the
+    bit-parity contract between them depends on it)."""
+    return dict(
+        max_bin=(config.max_bin_by_feature[j]
+                 if j < len(config.max_bin_by_feature) else config.max_bin),
+        min_data_in_bin=config.min_data_in_bin,
+        min_split_data=filter_cnt,
+        pre_filter=config.feature_pre_filter,
+        bin_type=(BIN_TYPE_CATEGORICAL if j in cat_set
+                  else BIN_TYPE_NUMERICAL),
+        use_missing=config.use_missing,
+        zero_as_missing=config.zero_as_missing,
+        forced_bounds=(forced_bounds or {}).get(j),
+    )
+
+
 def fit_mapper_for_column(j: int, vals: np.ndarray, total_sample_cnt: int,
                           config, cat_set, filter_cnt: int,
                           forced_bounds=None) -> BinMapper:
@@ -486,18 +532,9 @@ def fit_mapper_for_column(j: int, vals: np.ndarray, total_sample_cnt: int,
     through (reference: DatasetLoader::ConstructBinMappersFromTextData's
     per-feature FindBin call, dataset_loader.cpp:953-1140)."""
     m = BinMapper()
-    max_bin = (config.max_bin_by_feature[j]
-               if j < len(config.max_bin_by_feature) else config.max_bin)
-    m.find_bin(
-        vals, total_sample_cnt=total_sample_cnt, max_bin=max_bin,
-        min_data_in_bin=config.min_data_in_bin,
-        min_split_data=filter_cnt,
-        pre_filter=config.feature_pre_filter,
-        bin_type=BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL,
-        use_missing=config.use_missing,
-        zero_as_missing=config.zero_as_missing,
-        forced_bounds=(forced_bounds or {}).get(j),
-    )
+    m.find_bin(vals, total_sample_cnt=total_sample_cnt,
+               **_find_bin_kwargs(j, config, cat_set, filter_cnt,
+                                  forced_bounds))
     return m
 
 
@@ -521,6 +558,253 @@ def find_bin_mappers(X: np.ndarray, config, categorical_features: Sequence[int] 
             len(sample_idx), config, cat_set, filter_cnt, forced_bounds)
         for j in range(num_features)
     ]
+
+
+# ------------------------------------------------------- streaming sketch
+class FeatureSketch:
+    """Mergeable per-feature (distinct values, counts, NaN count) summary
+    for streaming bin finding — the TPU analog of the reference's
+    distributed bin-finding protocol (dataset_loader.cpp:1046-1128:
+    feature-sharded FindBin merged by Network::Allgather) crossed with the
+    sketch-based quantile binning of the scalable-GPU XGBoost paper
+    (PAPERS.md): row chunks fold in one at a time, sketches merge
+    associatively (across chunks AND across ranks over
+    ``distributed.exchange_host``), and a mapper fitted from the merged
+    sketch via :meth:`BinMapper.find_bin_from_distinct` equals the
+    sampled-path mapper exactly while the sketch stays EXACT.
+
+    ``max_size`` bounds the distinct-value budget: past it the sketch
+    compacts to equal-mass representatives (each kept value is the upper
+    edge of its mass group, so ``max_val`` is preserved and every group's
+    count collapses onto its edge). Each compaction moves a value's
+    cumulative rank by at most ``total/max_size``, so after ``L``
+    compactions boundary ranks are within ~``L/max_size`` of exact —
+    the documented rank error the parity tests assert. ``max_size=0``
+    means unbounded (exact)."""
+
+    __slots__ = ("max_size", "values", "counts", "na_cnt", "total_cnt",
+                 "compactions")
+
+    def __init__(self, max_size: int = 0):
+        self.max_size = int(max_size)
+        self.values = np.zeros((0,), np.float64)
+        self.counts = np.zeros((0,), np.int64)
+        self.na_cnt = 0
+        self.total_cnt = 0
+        self.compactions = 0
+
+    def fold(self, column: np.ndarray) -> None:
+        """Fold one chunk's raw column values (NaN included) into the
+        sketch. Bit-path note: NaNs are stripped and the rest go through
+        ``np.unique`` — the same normalization ``find_bin`` applies."""
+        col = np.asarray(column, dtype=np.float64).reshape(-1)
+        self.total_cnt += len(col)
+        na = np.isnan(col)
+        n_na = int(na.sum())
+        if n_na:
+            self.na_cnt += n_na
+            col = col[~na]
+        if len(col):
+            v, c = np.unique(col, return_counts=True)
+            self._merge_arrays(v, c.astype(np.int64))
+
+    def merge(self, other: "FeatureSketch") -> "FeatureSketch":
+        """Fold another sketch in (rank merge). Associative up to the
+        compaction error; exact when neither side ever compacted."""
+        self.na_cnt += other.na_cnt
+        self.total_cnt += other.total_cnt
+        self.compactions = max(self.compactions, other.compactions)
+        self._merge_arrays(other.values, other.counts)
+        return self
+
+    def _merge_arrays(self, v: np.ndarray, c: np.ndarray) -> None:
+        if len(v):
+            if len(self.values):
+                allv = np.concatenate([self.values, v])
+                allc = np.concatenate([self.counts, c])
+                uv, inv = np.unique(allv, return_inverse=True)
+                uc = np.zeros(len(uv), np.int64)
+                np.add.at(uc, inv.reshape(-1), allc)
+                self.values, self.counts = uv, uc
+            else:
+                self.values = np.asarray(v, np.float64).copy()
+                self.counts = np.asarray(c, np.int64).copy()
+        if self.max_size and len(self.values) > self.max_size:
+            self._compact()
+
+    def _compact(self) -> None:
+        """Equal-mass compaction to ``max_size`` representatives. The zero
+        slot is force-retained when present (the dedicated zero bin of
+        ``find_bin_with_zero_as_one_bin`` keys on it)."""
+        n = len(self.values)
+        m = self.max_size
+        cum = np.cumsum(self.counts)
+        total = int(cum[-1])
+        edges = np.searchsorted(cum, total * (np.arange(1, m + 1) / m),
+                                side="left")
+        edges = np.clip(edges, 0, n - 1)
+        zi = int(np.searchsorted(self.values, 0.0))
+        if zi < n and self.values[zi] == 0.0:
+            edges = np.append(edges, zi)
+        edges = np.unique(edges)
+        grp_cnt = np.diff(np.concatenate([[0], cum[edges]]))
+        self.values = self.values[edges]
+        self.counts = grp_cnt.astype(np.int64)
+        self.compactions += 1
+
+    @property
+    def exact(self) -> bool:
+        return self.compactions == 0
+
+    # JSON payloads for the cross-rank exchange_host merge: repr-based
+    # float serialization round-trips f64 bit-exactly, so a merged-then-
+    # fitted mapper is identical on every rank
+    def to_dict(self) -> dict:
+        return {"max_size": self.max_size,
+                "values": [float(x) for x in self.values],
+                "counts": [int(x) for x in self.counts],
+                "na_cnt": int(self.na_cnt),
+                "total_cnt": int(self.total_cnt),
+                "compactions": int(self.compactions)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FeatureSketch":
+        sk = cls(int(d.get("max_size", 0)))
+        sk.values = np.asarray(d["values"], np.float64)
+        sk.counts = np.asarray(d["counts"], np.int64)
+        sk.na_cnt = int(d["na_cnt"])
+        sk.total_cnt = int(d["total_cnt"])
+        sk.compactions = int(d.get("compactions", 0))
+        return sk
+
+
+def split_chunk(chunk):
+    """Normalize one chunk to ``(X [rows, F] ndarray, labels-or-None)``.
+    Chunk sources may yield bare feature arrays or ``(X, y)`` pairs."""
+    y = None
+    if isinstance(chunk, (tuple, list)) and len(chunk) == 2:
+        chunk, y = chunk
+    X = np.asarray(chunk)
+    if X.ndim == 1:
+        X = X.reshape(-1, 1)
+    if y is not None:
+        y = np.asarray(y, dtype=np.float64).reshape(-1)
+    return X, y
+
+
+def chunk_factory(source, chunk_rows: int = 0):
+    """Normalize a chunk source into a re-iterable factory (the streaming
+    construct runs TWO passes — sketch, then bin — so a one-shot
+    generator cannot feed it):
+
+    - a callable -> called per pass, must return a fresh iterator of
+      chunks (each a ``[rows, F]`` array or an ``(X, y)`` pair);
+    - a list/tuple of chunks -> iterated per pass;
+    - a 2-D array (or anything array-like with ``.shape``) -> sliced into
+      ``chunk_rows`` row views (no copies).
+    """
+    from .utils import log as _log
+    default = int(chunk_rows) if chunk_rows else (1 << 20)
+    if callable(source):
+        return source
+    if isinstance(source, (list, tuple)):
+        return lambda: iter(source)
+    if hasattr(source, "shape") and getattr(source, "ndim", 0) == 2:
+        def _slices():
+            n = source.shape[0]
+            for s in range(0, max(n, 1), default):
+                yield source[s:s + default]
+        return _slices
+    _log.fatal("chunk source must be re-iterable: a callable returning an "
+               "iterator of chunks, a sequence of chunk arrays, or a 2-D "
+               f"array (got {type(source).__name__}; a one-shot generator "
+               "cannot feed the two construct passes)")
+
+
+def sketch_chunks(factory, max_size: int = 0, track_bytes=None,
+                  fold: bool = True):
+    """Pass 1 of streaming construction: fold every chunk into per-feature
+    :class:`FeatureSketch` es, holding at most ONE raw chunk at a time.
+
+    Returns ``(sketches, num_data, chunk_sizes, labels)`` where ``labels``
+    is the concatenation of per-chunk label parts (None when chunks carry
+    no labels). ``track_bytes``: optional callback fed each chunk's raw
+    byte size (the construct_peak_bytes gauge). ``fold=False`` skips the
+    per-column fold (the dominant wall) but keeps the row/size/label
+    accounting and the mid-stream width check — the light pass a
+    reference-aligned valid set needs (its mappers come from the
+    reference)."""
+    sketches: Optional[List[FeatureSketch]] = None
+    num_data = 0
+    sizes: List[int] = []
+    label_parts: List[np.ndarray] = []
+    # explicit next() loop so the previous chunk's reference is DROPPED
+    # before the source builds the next one — a plain for-loop keeps the
+    # loop variable bound across next(), holding two chunks alive
+    it = iter(factory())
+    while True:
+        chunk = next(it, None)
+        if chunk is None:
+            break
+        X, y = split_chunk(chunk)
+        chunk = None
+        if track_bytes is not None:
+            track_bytes(int(getattr(X, "nbytes", 0)))
+        if sketches is None:
+            sketches = [FeatureSketch(max_size) for _ in range(X.shape[1])]
+        elif X.shape[1] != len(sketches):
+            from .utils import log as _log
+            _log.fatal(f"chunk feature count changed mid-stream: "
+                       f"{X.shape[1]} vs {len(sketches)}")
+        if fold:
+            for j in range(X.shape[1]):
+                sketches[j].fold(X[:, j])
+        num_data += X.shape[0]
+        sizes.append(X.shape[0])
+        if y is not None:
+            label_parts.append(y)
+        X = None
+    if sketches is None:
+        from .utils import log as _log
+        _log.fatal("chunk source yielded no chunks")
+    labels = np.concatenate(label_parts) if label_parts else None
+    return sketches, num_data, sizes, labels
+
+
+def fit_mappers_from_sketches(sketches: Sequence[FeatureSketch],
+                              num_data: int, config,
+                              categorical_features: Sequence[int] = (),
+                              forced_bounds: Optional[Dict[int, List[float]]]
+                              = None) -> List[BinMapper]:
+    """Fit one BinMapper per feature from (possibly rank-merged) sketches
+    — the streaming twin of :func:`find_bin_mappers`. With exact sketches
+    whose total covers every row, this IS the sampled path's fit (the
+    sample being all rows), so mappers are bit-identical whenever
+    ``num_data <= bin_construct_sample_cnt``."""
+    cat_set = set(int(c) for c in categorical_features)
+    total = int(sketches[0].total_cnt) if len(sketches) else 0
+    filter_cnt = filter_cnt_for_sample(config, total, num_data)
+    out = []
+    for j, sk in enumerate(sketches):
+        if j in cat_set and sk.compactions > 0:
+            # equal-mass compaction merges distinct CODES into their
+            # group's upper-edge code — meaningless for unordered
+            # categories and silently different from the sampled path.
+            # Fail loudly instead of fitting wrong category maps.
+            from .utils import log as _log
+            _log.fatal(
+                f"categorical feature {j} exceeded sketch_max_size "
+                f"({sk.max_size}) distinct codes during streaming "
+                f"construction and was compacted; raise sketch_max_size "
+                f"above the category count (rank-error compaction only "
+                f"applies to numerical features)")
+        m = BinMapper()
+        m.find_bin_from_distinct(
+            sk.values, sk.counts, sk.na_cnt, sk.total_cnt,
+            **_find_bin_kwargs(j, config, cat_set, filter_cnt,
+                               forced_bounds))
+        out.append(m)
+    return out
 
 
 def bin_data(X: np.ndarray, mappers: Sequence[BinMapper]) -> np.ndarray:
@@ -573,6 +857,68 @@ def device_bin_tables(mappers: Sequence[BinMapper]):
     return bounds, nan_to_zero, nan_bin
 
 
+def _quantize_block(xs, bd, nz, nb, odt):
+    """The device quantize predicate ONE block of float32 rows goes
+    through — shared by ``bin_data_device`` and ``StreamingBinWriter``
+    so the streaming path's bit-exactness contract (same bins as the
+    monolithic device pass, and via ``device_bin_tables`` the host pass)
+    is enforced structurally, not by parallel copies staying in sync.
+    ``xs [rows, F]`` f32, ``bd [F, Bpad]`` downshifted bounds, ``nz [F]``
+    NaN-as-zero mask, ``nb [F]`` NaN routing bin; returns ``[rows, F]``
+    of dtype ``odt``."""
+    import jax.numpy as jnp
+    v = jnp.where(jnp.isnan(xs) & nz[None, :], 0.0, xs)
+    cnt = jnp.sum(v[:, :, None] > bd[None, :, :], axis=-1, dtype=jnp.int32)
+    cnt = jnp.where(jnp.isnan(v), nb[None, :], cnt)
+    return cnt.astype(odt)
+
+
+def bin_chunks_host(factory, used: Sequence[BinMapper], uf, out: np.ndarray,
+                    track=None) -> None:
+    """Pass 2's HOST fallback: re-iterate the chunk source and write each
+    chunk's per-column ``bin_data`` result into its row slot of ``out``
+    — shared by ``Dataset._construct_streaming`` (non-f32/categorical
+    streams) and ``distributed.load_partitioned_chunks``. Maintains the
+    ref-dropping iteration discipline (<= the current chunk + its f64
+    column copy resident, reported through ``track``) and VERIFIES the
+    source yielded exactly ``len(out)`` rows — a source that under-yields
+    on its second iteration must fail loudly, not train on the zero
+    tail."""
+    from .utils import log as _log
+    row = 0
+    it = iter(factory())
+    while True:                            # ref-dropping next() loop
+        chunk = next(it, None)
+        if chunk is None:
+            break
+        X, _y = split_chunk(chunk)
+        chunk = None
+        n = X.shape[0]
+        if len(uf):
+            # subset FIRST, then widen: np.asarray(X, f64)[:, uf] would
+            # materialize a full-width f64 temp (2x the chunk) before
+            # the column select; f32->f64 is exact so this is
+            # bit-equivalent with a smaller transient
+            Xu = np.asarray(X[:, uf] if X.shape[1] != len(uf) else X,
+                            np.float64)
+            if track is not None:
+                # resident: the source chunk + its f64 column copy
+                track(X.nbytes + Xu.nbytes)
+            X = None
+            out[row:row + n] = bin_data(Xu, used)
+            Xu = None
+        else:
+            if track is not None:
+                track(X.nbytes)
+            X = None
+        row += n
+    if row != len(out):
+        _log.fatal(f"chunk source yielded {row} rows on the bin pass but "
+                   f"{len(out)} on the sketch pass: the source must be "
+                   f"re-iterable and deterministic (a one-shot iterator "
+                   f"cannot feed the two construct passes)")
+
+
 def bin_data_device(X, mappers: Sequence[BinMapper], block: int = 1 << 17):
     """Quantize a float32 matrix on device (the TPU replacement for the
     host ``bin_data`` loop — this box's single CPU core makes the host
@@ -596,11 +942,7 @@ def bin_data_device(X, mappers: Sequence[BinMapper], block: int = 1 << 17):
     @functools.partial(jax.jit, static_argnames=("odt",))
     def run(xd, bd, nz, nb, odt):
         def body(_, xb):
-            v = jnp.where(jnp.isnan(xb) & nz[None, :], 0.0, xb)
-            cnt = jnp.sum(v[:, :, None] > bd[None, :, :], axis=-1,
-                          dtype=jnp.int32)
-            cnt = jnp.where(jnp.isnan(v), nb[None, :], cnt)
-            return _, cnt.astype(odt)
+            return _, _quantize_block(xb, bd, nz, nb, odt)
 
         _, bins = jax.lax.scan(body, 0, xd.reshape(-1, c, fs))
         return bins.reshape(-1, fs)
@@ -609,3 +951,106 @@ def bin_data_device(X, mappers: Sequence[BinMapper], block: int = 1 << 17):
     bins = run(xd, jnp.asarray(bounds), jnp.asarray(nan_to_zero),
                jnp.asarray(nan_bin), out_dtype)
     return bins[:n] if pad else bins
+
+
+class StreamingBinWriter:
+    """Pass 2 of streaming construction: quantize float32 row chunks ON
+    DEVICE and write each into its row slot of one preallocated (donated)
+    ``[N_pad, F]`` bin matrix — the pre-sharded destination of the chunked
+    pipeline (SNIPPETS.md [1] naive-sharding: the leading axis is the one
+    a row-sharded mesh splits). Every ``write`` is one async jitted
+    dispatch (pad to a fixed chunk shape -> one compiled program), so
+    chunk k's H2D transfer + device quantize overlap chunk k+1's host
+    parse: the double buffer is the dispatch queue itself, and host
+    residency stays at the current chunk + its padded copy (<= 2 chunks
+    of raw data). Quantization is the ``bin_data_device`` predicate —
+    bit-exact vs the host ``bin_data`` path for float32 input (see
+    ``device_bin_tables``).
+
+    Residency is HARD-BOUNDED, not best-effort: each ``write`` first
+    drains the previous dispatch (at most ONE write in flight — the
+    caller's parse of chunk k+1 already overlapped chunk k's transfer
+    and compute between the two calls, so the wait costs no overlap) and
+    copies the chunk into a fresh staging buffer rather than handing the
+    caller's array to jax (which may pin it for the dispatch lifetime).
+    Peak host residency: one source chunk + one staged copy — the
+    "<= 2 chunks of raw data" acceptance bound; an unbounded dispatch
+    queue would instead retain O(queue-depth) chunks.
+
+    Writes past a chunk's true row count spill pad garbage into the NEXT
+    chunk's slot, which that chunk's later write overwrites (dispatches
+    are ordered by the donated-buffer dependency); the allocation keeps
+    ``max_chunk_rows`` spare rows so the LAST chunk's spill stays in
+    bounds, and ``finalize`` slices the matrix back to ``total_rows``.
+    """
+
+    def __init__(self, mappers: Sequence[BinMapper], total_rows: int,
+                 max_chunk_rows: int, sub_block: int = 1 << 15):
+        import jax
+        import jax.numpy as jnp
+
+        assert all(m.bin_type == BIN_TYPE_NUMERICAL for m in mappers)
+        self._num_mappers = len(mappers)
+        self.f = max(len(mappers), 1)
+        bounds, nan_to_zero, nan_bin = (
+            device_bin_tables(mappers) if len(mappers)
+            else (np.full((1, 1), np.inf, np.float32),
+                  np.zeros((1,), bool), np.zeros((1,), np.int32)))
+        max_bin = max((m.num_bin for m in mappers), default=2)
+        self.dtype = jnp.uint8 if max_bin <= 256 else jnp.int32
+        self.n = int(total_rows)
+        c = min(int(sub_block), max(int(max_chunk_rows), 1))
+        self.chunk_pad = -(-max(int(max_chunk_rows), 1) // c) * c
+        self._sub = c
+        self._bounds = jnp.asarray(bounds)
+        self._nz = jnp.asarray(nan_to_zero)
+        self._nb = jnp.asarray(nan_bin)
+        self._out = jnp.zeros((self.n + self.chunk_pad, self.f), self.dtype)
+        self._next = 0
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _write(out, xb, start, bd, nz, nb):
+            def body(_, xs):
+                return _, _quantize_block(xs, bd, nz, nb, out.dtype)
+
+            _, bins = jax.lax.scan(body, 0, xb.reshape(-1, c, xb.shape[1]))
+            return jax.lax.dynamic_update_slice(
+                out, bins.reshape(-1, xb.shape[1]), (start, 0))
+
+        self._write_fn = _write
+
+    def write(self, chunk: np.ndarray) -> None:
+        """Dispatch one chunk's quantize-and-place (async), after
+        draining the PREVIOUS write — see the class docstring's
+        residency bound."""
+        import jax
+        import jax.numpy as jnp
+        chunk = np.asarray(chunk, dtype=np.float32)
+        if chunk.ndim == 1:
+            chunk = chunk.reshape(-1, 1)
+        rows = chunk.shape[0]
+        assert rows <= self.chunk_pad, (rows, self.chunk_pad)
+        assert self._next + rows <= self.n, "writer overflow"
+        if self._num_mappers != 0:
+            assert chunk.shape[1] == self.f, (chunk.shape, self.f)
+        if self._next:
+            jax.block_until_ready(self._out)   # <= 1 write in flight
+        staged = np.zeros((self.chunk_pad, self.f), np.float32)
+        if self._num_mappers != 0:
+            staged[:rows] = chunk
+        del chunk                              # staging owns the only copy
+        self._out = self._write_fn(self._out, jnp.asarray(staged),
+                                   jnp.int32(self._next), self._bounds,
+                                   self._nz, self._nb)
+        self._next += rows
+
+    def finalize(self):
+        """Drain the dispatch queue and return the device ``[N, F]`` bin
+        matrix. The blocking wait here is the NON-overlapped tail of the
+        pipeline — callers time it as the ``h2d_overlap`` sub-scope."""
+        import jax
+        assert self._next == self.n, (self._next, self.n)
+        out, self._out = self._out, None
+        out = out[:self.n]
+        jax.block_until_ready(out)
+        return out

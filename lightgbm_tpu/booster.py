@@ -233,6 +233,11 @@ class Booster:
             ts._traversal_bins_cache = None
             ts.label = ts.weight = ts.init_score = None
             ts.raw_data_np = None
+            # streaming-construct datasets must not keep the chunk source
+            # pinned either (it may hold file handles or closures over
+            # generator state) — the construct-re-entry audit twin of the
+            # monolithic raw release above
+            ts._chunk_source = None
         b.train_score = None
         # valid sets hold the other O(N) device arrays (bins, per-row
         # scores, raw caches) — the reference frees its datasets wholesale

@@ -340,6 +340,19 @@ class Config:
     max_bin_by_feature: List[int] = field(default_factory=list)
     min_data_in_bin: int = 3
     bin_construct_sample_cnt: int = 200000
+    # streaming chunked construction (binning.py FeatureSketch +
+    # StreamingBinWriter, basic.py Dataset.from_chunks): rows per chunk
+    # when slicing monolithic array input (0 = auto, ~1M-row chunks);
+    # chunk sources keep their own chunk sizes
+    construct_chunk_rows: int = 0
+    # route Dataset.construct through the two-pass streaming path (sketch
+    # pass -> device bin pass, host memory O(chunk)) even for monolithic
+    # array input; chunk-source datasets always stream
+    construct_streaming: bool = False
+    # per-feature distinct-value budget of the mergeable construct sketch;
+    # 0 = exact (unbounded). Past it the sketch compacts to equal-mass
+    # representatives (rank error ~compactions/sketch_max_size)
+    sketch_max_size: int = 65536
     data_random_seed: int = 1
     is_enable_sparse: bool = True
     enable_bundle: bool = True

@@ -1659,8 +1659,15 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
     # EFB over the agreed (allgathered) sample: identical inputs on every
     # process -> identical bundle assignment, so the bundled column layout
     # needs no further cross-host negotiation (the analog of the
-    # reference's sample-driven FastFeatureBundling, dataset.cpp:239)
-    ds._run_bundling(sample, len(sample), config)
+    # reference's sample-driven FastFeatureBundling, dataset.cpp:239).
+    # enable_bundle=false skips the bundling machinery ENTIRELY (plain
+    # per-feature columns) rather than building singleton bundles — the
+    # layout load_partitioned_chunks produces, so the chunked and
+    # monolithic loaders are bit-comparable with bundling off
+    if config.enable_bundle:
+        ds._run_bundling(sample, len(sample), config)
+    else:
+        ds.bundles = None
     if ds.bundles is not None and len(ds.bundles):
         ds._build_feature_meta_bundled(config)
         local_bins = ds._bin_columns(X)
@@ -1672,13 +1679,36 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
             X[:, ds.used_features] if len(ds.used_features)
             else np.zeros((n_local, 0)), used)
     dtype = np.uint8 if ds.max_num_bins <= 256 else np.int32
-    local_bins = local_bins.astype(dtype)
+    _shard_local_bins(ds, local_bins.astype(dtype), local_counts)
+    g = ds.num_used_features()
+    log.info(f"pre-partitioned dataset: {n_local} local rows of "
+             f"{n_global} global, {len(ds.used_features)} used features"
+             + (f" (bundled into {g} columns)" if ds.bundles else ""))
+    return ds
+
+
+def _shard_local_bins(ds, local_bins, local_counts) -> None:
+    """Assemble a rank's LOCAL binned rows into the global row-sharded
+    device matrix and finish the pre-partitioned Dataset bookkeeping —
+    the shared tail of ``load_partitioned`` (monolithic local matrix) and
+    ``load_partitioned_chunks`` (streamed local chunks). ``local_counts``
+    is every rank's local row count in rank order (array or list)."""
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .parallel.data_parallel import make_mesh
+
+    nproc = jax.process_count()
+    n_local = int(local_bins.shape[0])
+    counts = [int(c) for c in np.asarray(local_counts).reshape(-1)]
     # pad local rows to a common per-process count divisible by the local
     # device count so the global sharding has equal shards; padded rows are
     # excluded from histograms by the zero-padded sample mask the grower
     # applies
     n_loc_dev = jax.local_device_count()
-    max_local = int(np.max(local_counts))
+    max_local = max(counts)
     target = -(-max_local // n_loc_dev) * n_loc_dev
     if target > n_local:
         local_bins = np.pad(local_bins, ((0, target - n_local), (0, 0)))
@@ -1699,14 +1729,174 @@ def load_partitioned(data, label=None, weight=None, init_score=None,
     # rank's first global row and every rank's local row count (the
     # PARTITION.json the checkpoint writer records; see checkpoint.py)
     rank = jax.process_index()
-    counts = [int(c) for c in np.asarray(local_counts).reshape(-1)]
     ds.partition_counts = counts
     ds.local_row_start = int(sum(counts[:rank]))
     ds._constructed = True
     if ds.free_raw_data:
         ds.data = None
+
+
+def merge_feature_sketches(sketches, tag: str = "construct"):
+    """Allgather per-feature construct sketches as JSON over
+    ``exchange_host`` and fold them together IN RANK ORDER — the
+    streaming twin of the reference's distributed bin finding
+    (dataset_loader.cpp:1046-1128: per-machine FindBin merged by
+    Network::Allgather). Deterministic: every rank receives the same
+    payloads in the same order and merges identically, so the mappers
+    fitted from the result agree bit-exactly everywhere (float values
+    serialize via repr, which round-trips f64). Single process: returns
+    the input unchanged. Payload size is bounded by
+    ``num_features * sketch_max_size`` distinct values — the sketch, not
+    the data, is what crosses hosts."""
+    import jax
+
+    from . import binning
+
+    if jax.process_count() <= 1:
+        return list(sketches)
+    sketches = list(sketches)
+    # agree on the feature count FIRST (one tiny exchange): a mismatch
+    # must fail loudly here — discovered later it would desync the
+    # batched exchange below into a lockstep hang
+    nfs = [int(json.loads(p)) for p in
+           exchange_host(f"sketch_{tag}_nf", json.dumps(len(sketches)))]
+    if len(set(nfs)) != 1:
+        log.fatal(f"pre-partitioned chunk sources disagree on feature "
+                  f"count across ranks: {nfs}")
+    # exchange_host's contract is SMALL payloads (its KV store has no
+    # chunking): a saturated sketch is ~sketch_max_size repr'd f64s per
+    # feature (~25 B each), so features are exchanged in batches bounded
+    # to a few MB. Batch boundaries derive only from values every rank
+    # agrees on (feature count + the config's sketch_max_size), keeping
+    # the per-batch tags in lockstep.
+    max_size = max((sk.max_size for sk in sketches), default=0)
+    per_batch = (len(sketches) if not max_size
+                 else max(1, (4 << 20) // max(1, max_size * 25)))
+    merged: List = []
+    for b0 in range(0, len(sketches), per_batch):
+        batch = sketches[b0:b0 + per_batch]
+        payload = json.dumps([sk.to_dict() for sk in batch])
+        parts = exchange_host(f"sketch_{tag}_b{b0}", payload)
+        batch_merged = [binning.FeatureSketch.from_dict(d)
+                        for d in json.loads(parts[0])]
+        for r, part in enumerate(parts[1:], start=1):
+            dicts = json.loads(part)
+            if len(dicts) != len(batch_merged):
+                # a zip would silently truncate and fit subtly-wrong
+                # mappers deterministically on every rank — fail loudly
+                # instead, like sketch_chunks' mid-stream width check
+                log.fatal(f"rank {r} sketched {len(dicts)} features in "
+                          f"batch {b0}, rank 0 sketched "
+                          f"{len(batch_merged)}: pre-partitioned chunk "
+                          f"sources disagree on feature count")
+            for sk, d in zip(batch_merged, dicts):
+                sk.merge(binning.FeatureSketch.from_dict(d))
+        merged.extend(batch_merged)
+    return merged
+
+
+def load_partitioned_chunks(chunks, label=None, weight=None, init_score=None,
+                            params: Optional[dict] = None,
+                            feature_name="auto",
+                            categorical_feature="auto"):
+    """Streaming pre-partitioned loader: each process folds ITS OWN row
+    chunks into per-feature sketches (host memory O(chunk) — the raw
+    local matrix never materializes), the sketches merge across ranks
+    over ``exchange_host`` (:func:`merge_feature_sketches`), identical
+    BinMappers are fitted everywhere from the merged summaries, and each
+    rank bins its chunks straight into its shard of the global
+    row-sharded bin matrix. The chunked twin of :func:`load_partitioned`
+    for the 100M-row regime where even one host's row slice dwarfs RAM.
+
+    ``chunks``: this rank's local chunk source (``binning.chunk_factory``
+    forms: callable/sequence/2-D array), each chunk ``[rows, F]`` or an
+    ``(X, y)`` pair whose label parts concatenate into the local label.
+    EFB bundling does not apply (it needs sampled row patterns; dense
+    chunk columns map 1:1 to device columns like the dense monolithic
+    construct) — for parity against ``load_partitioned`` run that side
+    with ``enable_bundle=false``. Same training contract as
+    ``load_partitioned``: label/weight stay process-local,
+    ``tree_learner="data"``/voting, no dart/linear_tree."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from . import binning
+    from .basic import Dataset, _load_forced_bins
+    from .config import Config
+    from .utils import profiling
+
+    config = Config.from_params(dict(params or {}))
+    profiling.drop_gauges("construct_")   # this construction's gauges only
+    factory = binning.chunk_factory(chunks, config.construct_chunk_rows)
+    peak = [0]
+
+    def track(nbytes, mult=1):
+        peak[0] = max(peak[0], mult * int(nbytes))
+
+    t0 = _time.time()
+    with profiling.timer("sketch_pass"):
+        sketches, n_local, sizes, chunk_labels = binning.sketch_chunks(
+            factory, max_size=config.sketch_max_size, track_bytes=track)
+        merged = merge_feature_sketches(sketches)
+    sketch_s = _time.time() - t0
+    del sketches
+    f = len(merged)
+    n_global = int(merged[0].total_cnt) if f else 0
+    counts = [int(json.loads(p)) for p in
+              exchange_host("prepart_chunk_rows", json.dumps(int(n_local)))]
+    assert sum(counts) == n_global or f == 0, (counts, n_global)
+
+    if chunk_labels is not None:
+        if label is not None:
+            log.fatal("labels were passed both to load_partitioned_chunks "
+                      "and in the chunk stream; pass one or the other")
+        label = chunk_labels
+    ds = Dataset(None, label=label, weight=weight, init_score=init_score,
+                 params=dict(params or {}), feature_name=feature_name,
+                 categorical_feature=categorical_feature)
+    names = ([f"Column_{i}" for i in range(f)]
+             if feature_name in ("auto", None) else list(feature_name))
+    ds._feature_names = names
+    cats = ds._resolve_categorical(f, names)
+    forced = _load_forced_bins(config, f, cats)
+    mappers = binning.fit_mappers_from_sketches(merged, n_global, config,
+                                                cats, forced_bounds=forced)
+    ds.mappers = mappers
+    ds.used_features = np.array(
+        [j for j, m in enumerate(mappers) if not m.is_trivial], np.int32)
+    ds.num_data = n_global
+    ds.num_total_features = f
+    ds.bundles = None
+    ds._build_feature_meta(config)
+
+    # second pass: bin each local chunk into its slot of the local shard
+    # (host per-chunk bin_data: the shard crosses into the global array
+    # as a host-local contribution, so the rows are needed host-side)
+    used = [mappers[j] for j in ds.used_features]
+    uf = ds.used_features
+    dtype = np.uint8 if ds.max_num_bins <= 256 else np.int32
+    local_bins = np.zeros((n_local, max(len(uf), 1)), dtype)
+    t0 = _time.time()
+    with profiling.timer("bin_pass"):
+        # shared host bin-pass helper: ref-dropping iteration (<= the
+        # current chunk + its f64 column copy resident) and a LOUD
+        # failure when the source under-yields on re-iteration
+        binning.bin_chunks_host(factory, used, uf, local_bins, track)
+    bin_s = _time.time() - t0
+    profiling.set_gauge("construct_sketch_s", sketch_s)
+    profiling.set_gauge("construct_bin_s", bin_s)
+    profiling.set_gauge("construct_peak_bytes", float(peak[0]))
+    profiling.set_gauge("construct_rows", float(n_local))
+    ds.construct_stats = {
+        "sketch_pass": round(sketch_s, 6), "bin_pass": round(bin_s, 6),
+        "peak_host_bytes": int(peak[0]), "rows": int(n_local),
+    }
+    _shard_local_bins(ds, local_bins, counts)
     g = ds.num_used_features()
-    log.info(f"pre-partitioned dataset: {n_local} local rows of "
-             f"{n_global} global, {len(ds.used_features)} used features"
-             + (f" (bundled into {g} columns)" if ds.bundles else ""))
+    log.info(f"pre-partitioned streaming dataset: {n_local} local rows of "
+             f"{n_global} global in {len(sizes)} chunks "
+             f"(peak raw {peak[0]} bytes), {len(ds.used_features)} used "
+             f"features across {g} columns")
     return ds

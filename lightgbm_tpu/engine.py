@@ -103,6 +103,23 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
                               "Dataset whose raw data was freed")
                 vs.init_score = loaded.predict_raw(vs.data)
 
+    # construct the training data BEFORE the booster so the phase is
+    # attributable in the TIMETAG table (streaming construction nests its
+    # sketch_pass / bin_pass / h2d_overlap sub-scopes under this),
+    # replicating Booster.__init__'s exact pre-construct protocol: params
+    # merge first (max_bin etc. in TRAIN params must reach binning), then
+    # the multi-machine bootstrap. A pre-constructed (load_partitioned)
+    # dataset no-ops through.
+    if not train_set._constructed:
+        from . import distributed
+        from .config import Config
+        from .utils import profiling
+        merged = dict(train_set.params or {})
+        merged.update(params)
+        train_set.params = merged
+        distributed.maybe_init_from_config(Config.from_params(params))
+        with profiling.timer("construct"):
+            train_set.construct()
     booster = Booster(params=params, train_set=train_set)
     if loaded is not None and loaded.num_trees > 0:
         booster._boosting.loaded = loaded

@@ -2357,6 +2357,16 @@ class GBDT:
                     self.config, "boost_rounds_per_dispatch", 1)),
                 num_leaves=int(self.config.num_leaves),
                 tree_learner=self.config.tree_learner)
+            # streaming-construct phase telemetry (sketch/bin/h2d walls,
+            # peak resident raw-chunk bytes) rides the header so a
+            # post-mortem names how THIS training set was built — read
+            # from the dataset's own construct_stats, not the process
+            # gauges, so a valid set's (or any later) construct cannot
+            # wipe or substitute it; absent when the training set was
+            # constructed monolithically
+            construct = getattr(self.train_set, "construct_stats", None)
+            if construct:
+                flight.set_context(construct=dict(construct))
 
     def _record_aux_counters(self, aux: GrowAux) -> None:
         """Accumulate a tree's histogram-pass row count and collective

@@ -117,6 +117,39 @@ def snapshot() -> Dict[str, Any]:
     }
 
 
+def construct_snapshot() -> Dict[str, Any]:
+    """Construct-phase telemetry in one dict — the single spelling the
+    flight-recorder header, ``bench.py``'s construct fields and the
+    smoke scripts all read. Sources: the always-on gauges the streaming
+    construct records (``construct_sketch_s`` / ``construct_bin_s`` /
+    ``construct_h2d_overlap_s`` / ``construct_peak_bytes`` /
+    ``construct_rows``, basic.py ``_construct_streaming`` and
+    ``distributed.load_partitioned_chunks``). Process-level semantics:
+    describes the LAST streaming construct in this process (each one
+    drops the family first) — bench/smoke read it right after
+    constructing; per-DATASET attribution (what the flight-recorder
+    header uses) lives on ``Dataset.construct_stats`` instead. Empty
+    dict when no streaming construct ran in this process.
+    ``rows_per_sec`` is rows / (sketch + bin) wall."""
+    from .utils import profiling
+    g = profiling.gauges()
+    out: Dict[str, Any] = {}
+    for gauge, key in (("construct_sketch_s", "sketch_pass"),
+                       ("construct_bin_s", "bin_pass"),
+                       ("construct_h2d_overlap_s", "h2d_overlap")):
+        if gauge in g:
+            out[key] = round(float(g[gauge]), 6)
+    if "construct_peak_bytes" in g:
+        out["peak_host_bytes"] = int(g["construct_peak_bytes"])
+    if "construct_rows" in g:
+        out["rows"] = int(g["construct_rows"])
+        wall = float(g.get("construct_sketch_s", 0.0)
+                     + g.get("construct_bin_s", 0.0))
+        if wall > 0:
+            out["rows_per_sec"] = round(out["rows"] / wall, 1)
+    return out
+
+
 _METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 

@@ -126,6 +126,19 @@ def gauges() -> Dict[str, float]:
         return dict(_gauges)
 
 
+def drop_gauges(prefix: str) -> None:
+    """Remove every gauge whose name starts with ``prefix``. Gauges are
+    last-value-wins and process-global, so a measurement family scoped
+    to an EVENT (e.g. the ``construct_*`` gauges of one dataset
+    construction) must be dropped when the next event starts — otherwise
+    consumers (the flight-recorder header, ``telemetry
+    .construct_snapshot``) attribute a previous event's values to the
+    current one."""
+    with _lock:
+        for k in [k for k in _gauges if k.startswith(prefix)]:
+            del _gauges[k]
+
+
 def _sync_fetch(value) -> None:
     """Block on ``value`` (an array or pytree) and fetch one scalar of it
     — the scope-exit barrier both ``timer`` and ``timer_sync`` use so a

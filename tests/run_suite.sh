@@ -39,6 +39,14 @@ python -u "$(dirname "$0")/../scripts/compile_wall_smoke.py" || fail=1
 # candidate swaps in bit-identical to a cold load
 echo "=== scripts/serve_smoke.py"
 python -u "$(dirname "$0")/../scripts/serve_smoke.py" || fail=1
+# streaming-construct smoke (fast knobs, ~20 s on CPU): chunked
+# two-pass construct -> 3 boosting rounds, bit-identical mappers/bins/
+# model text vs monolithic; raw-chunk residency <= 2 chunks (weakref
+# census + construct_peak_bytes gauge); sketch/bin/h2d telemetry on
+# record; compacted-sketch rank error within the documented budget;
+# free_dataset / construct re-entry audited on the chunked path
+echo "=== scripts/construct_smoke.py"
+python -u "$(dirname "$0")/../scripts/construct_smoke.py" || fail=1
 # telemetry smoke (fast knobs, ~20 s on CPU): kill-at-iteration flushes
 # a flight-recorder JSONL that schema-validates and names the in-flight
 # iteration; a clean run flushes at train end with the health snapshot

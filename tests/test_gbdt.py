@@ -7,7 +7,13 @@ import pytest
 import lightgbm_tpu as lgb
 
 
+@pytest.mark.slow
 def test_regression_learns(rng):
+    """slow: a pure quality claim (50-round mse bar). Regression-objective
+    mechanics stay tier-1 via test_l1_objective_with_renew, the sklearn
+    LGBMRegressor surface (test_sklearn) and the reference-consistency
+    regression cells; the full objective quality matrix is slow-tier by
+    design (test_objective_matrix)."""
     n = 2000
     X = rng.normal(size=(n, 10))
     y = X[:, 0] * 3 + np.sin(X[:, 1] * 2) + 0.1 * rng.normal(size=n)

@@ -21,7 +21,14 @@ def piecewise_linear():
     return X, y
 
 
+@pytest.mark.slow
 def test_linear_beats_plain_on_linear_target(piecewise_linear):
+    """slow: a pure quality claim (linear leaves beat constant leaves on
+    a piecewise-linear target — the same class as the
+    categorical-beats-numerical claim moved in PR 6). Linear-tree
+    mechanics stay tier-1 via the model round trip, NaN fallback,
+    valid-eval consistency, binary objective and device-vs-predict
+    scoring tests in this file."""
     from sklearn.metrics import r2_score
     X, y = piecewise_linear
     plain = lgb.train(BASE, lgb.Dataset(X, label=y, params=BASE,

@@ -1,0 +1,385 @@
+"""Streaming chunked dataset construction (ISSUE 14).
+
+Parity bars:
+- sketch-fitted BinMappers are BIT-IDENTICAL to the sampled
+  ``find_bin_mappers`` whenever one chunk covers the sample (exact
+  sketches, sample = all rows);
+- compacted sketches stay within the documented rank-error budget
+  (~2 * compactions / sketch_max_size);
+- chunked-vs-monolithic construct trains to bit-identical model text
+  (gbdt), on both the device f32 writer path and the f64 host fallback;
+- host residency of raw chunk data is O(chunk): <= 2 chunks alive at
+  any moment (weakref census) and the ``construct_peak_bytes`` gauge
+  records it;
+- the per-feature sketches JSON-round-trip bit-exactly and merge
+  associatively — the ``distributed.exchange_host`` rank-merge protocol
+  (exercised cross-process by the slow 2-rank test below).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import weakref
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import binning
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.utils import profiling
+
+
+TRAIN = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbosity": -1}
+
+
+def _data(rng, n=3000, f=6, dtype=np.float32):
+    X = rng.normal(size=(n, f)).astype(dtype)
+    X[:, f - 2] *= (rng.rand(n) < 0.3)          # zero-heavy column
+    X[rng.rand(n) < 0.05, f - 1] = np.nan       # NaN column
+    y = (np.nan_to_num(X[:, 0] + 0.5 * X[:, 1] - X[:, f - 2]) > 0) \
+        .astype(np.float64)
+    return X, y
+
+
+def _mapper_json(mappers):
+    return json.dumps([m.to_dict() for m in mappers])
+
+
+def test_sketch_mappers_bit_identical_when_sample_fits_one_chunk(rng):
+    """Exact sketches (no compaction, sample covers all rows) fit the
+    SAME mappers as the sampled monolithic path — bit for bit."""
+    X, y = _data(rng, n=2000, f=5)
+    ds_m = lgb.Dataset(X.copy(), label=y, params={"verbosity": -1})
+    ds_m.construct()
+    chunks = [(X[s:s + 700], y[s:s + 700]) for s in range(0, len(X), 700)]
+    ds_c = lgb.Dataset.from_chunks(chunks, params={"verbosity": -1})
+    ds_c.construct()
+    assert _mapper_json(ds_m.mappers) == _mapper_json(ds_c.mappers)
+    assert np.array_equal(np.asarray(ds_m.bins), np.asarray(ds_c.bins))
+
+
+def test_chunked_vs_monolithic_model_text_identical(rng):
+    """One monolithic reference training; BOTH streaming front ends —
+    ``from_chunks`` and the ``construct_streaming``/``construct_chunk_rows``
+    params on array input — must train to bit-identical model text, and
+    the chunked dataset passes the free_dataset / re-entry audit (no
+    stale raw or chunk-source reference pinned)."""
+    X, y = _data(rng, n=2000, f=5)
+    b_m = lgb.train(dict(TRAIN),
+                    lgb.Dataset(X.copy(), label=y, params={"verbosity": -1}),
+                    num_boost_round=4)
+    chunks = [(X[s:s + 700], y[s:s + 700]) for s in range(0, len(X), 700)]
+    ds_c = lgb.Dataset.from_chunks(chunks, params={"verbosity": -1})
+    b_c = lgb.train(dict(TRAIN), ds_c, num_boost_round=4)
+    assert b_m.model_to_string() == b_c.model_to_string()
+    ds_s = lgb.Dataset(X.copy(), label=y,
+                       params={"verbosity": -1, "construct_streaming": True,
+                               "construct_chunk_rows": 700})
+    b_s = lgb.train(dict(TRAIN), ds_s, num_boost_round=4)
+    assert b_m.model_to_string() == b_s.model_to_string()
+    # free_dataset / construct re-entry audit on the chunked path
+    assert ds_c.data is None and ds_c._chunk_source is None
+    assert ds_c.raw_data_np is None
+    assert ds_c.construct() is ds_c
+    b_c.free_dataset()
+    assert ds_c.bins is None and ds_c._chunk_source is None
+    assert ds_c.label is None
+    _ = b_c.predict(X[:32])                 # binning metadata survives
+
+
+def test_host_fallback_f64_chunks_identical(rng):
+    """Non-f32 chunks take the host per-chunk bin_data path: mappers and
+    bin matrix bit-identical to the monolithic f64 construct (model-text
+    parity for this path rides test_load_partitioned_chunks_* — which
+    bins f64 chunks host-side — and scripts/construct_smoke.py)."""
+    X, y = _data(rng, n=2000, f=5, dtype=np.float64)
+    ds_m = lgb.Dataset(X.copy(), label=y, params={"verbosity": -1})
+    ds_m.construct()
+    chunks = [X[s:s + 700].astype(np.float64) for s in range(0, len(X), 700)]
+    ds_c = lgb.Dataset.from_chunks(chunks, label=y,
+                                   params={"verbosity": -1})
+    ds_c.construct()
+    assert _mapper_json(ds_m.mappers) == _mapper_json(ds_c.mappers)
+    assert np.array_equal(np.asarray(ds_m.bins), np.asarray(ds_c.bins))
+
+
+def test_valid_set_aligns_to_streaming_reference(rng):
+    """A validation set referencing a streaming-constructed train set
+    adopts its mappers (the dense alignment contract)."""
+    X, y = _data(rng, n=2000, f=5)
+    chunks = [(X[s:s + 700], y[s:s + 700]) for s in range(0, len(X), 700)]
+    ds = lgb.Dataset.from_chunks(chunks, params={"verbosity": -1})
+    Xv, yv = _data(np.random.RandomState(9), n=700, f=5)
+    ev = {}
+    lgb.train(dict(TRAIN), ds, num_boost_round=3,
+              valid_sets=[ds.create_valid(Xv, label=yv)],
+              valid_names=["v"], evals_result=ev)
+    assert "v" in ev and len(next(iter(ev["v"].values()))) == 3
+
+
+def test_sketch_compaction_rank_error_budget():
+    """A compacted sketch's cumulative ranks stay within the documented
+    ~2*compactions/max_size of exact, and the fitted mapper keeps a
+    healthy bin count."""
+    col = np.random.RandomState(5).normal(size=20000)
+    sk = binning.FeatureSketch(max_size=256)
+    for s in range(0, len(col), 2500):
+        sk.fold(col[s:s + 2500])
+    assert sk.compactions > 0 and len(sk.values) <= 256
+    sv = np.sort(col)
+    sketch_rank = np.cumsum(sk.counts) / sk.total_cnt
+    true_rank = np.searchsorted(sv, sk.values, side="right") / len(col)
+    err = float(np.max(np.abs(sketch_rank - true_rank)))
+    assert err <= 2.0 * sk.compactions / sk.max_size, err
+    cfg = Config.from_params({"verbosity": -1})
+    m = binning.fit_mappers_from_sketches([sk], len(col), cfg)[0]
+    assert m.num_bin > 200
+
+
+def test_sketch_zero_slot_survives_compaction():
+    rng = np.random.RandomState(2)
+    col = np.where(rng.rand(10000) < 0.4, 0.0, rng.normal(size=10000))
+    sk = binning.FeatureSketch(max_size=64)
+    for s in range(0, len(col), 1000):
+        sk.fold(col[s:s + 1000])
+    zi = np.searchsorted(sk.values, 0.0)
+    assert zi < len(sk.values) and sk.values[zi] == 0.0
+
+
+def test_sketch_json_roundtrip_and_merge():
+    """to_dict/from_dict round-trips f64 bit-exactly (the exchange_host
+    payload), and merging two half-sketches equals folding the whole."""
+    rng = np.random.RandomState(3)
+    col = rng.normal(size=2000)
+    whole = binning.FeatureSketch()
+    whole.fold(col)
+    a, b = binning.FeatureSketch(), binning.FeatureSketch()
+    a.fold(col[:1100])
+    b.fold(col[1100:])
+    a.merge(binning.FeatureSketch.from_dict(
+        json.loads(json.dumps(b.to_dict()))))
+    assert a.total_cnt == whole.total_cnt
+    assert np.array_equal(a.values, whole.values)
+    assert np.array_equal(a.counts, whole.counts)
+    rt = binning.FeatureSketch.from_dict(json.loads(json.dumps(
+        whole.to_dict())))
+    assert np.array_equal(rt.values, whole.values)
+
+
+def test_merge_feature_sketches_single_process():
+    from lightgbm_tpu import distributed
+    sk = binning.FeatureSketch()
+    sk.fold(np.arange(10.0))
+    merged = distributed.merge_feature_sketches([sk])
+    assert merged[0] is sk or np.array_equal(merged[0].values, sk.values)
+
+
+def test_streaming_memory_bounded_and_gauges(rng):
+    """<= 2 raw chunks alive at any moment (weakref census over a
+    generator source) and the construct gauges record the peak."""
+    X, y = _data(rng, n=2000, f=5)
+    chunk = 700
+    live, peak_live = set(), [0]
+
+    def factory():
+        def gen():
+            for s in range(0, len(X), chunk):
+                c = np.array(X[s:s + chunk])
+                live.add(id(c))
+                weakref.finalize(c, live.discard, id(c))
+                peak_live[0] = max(peak_live[0], len(live))
+                yield c, np.array(y[s:s + chunk])
+        return gen()
+
+    ds = lgb.Dataset.from_chunks(factory, params={"verbosity": -1})
+    ds.construct()
+    assert peak_live[0] <= 2, f"{peak_live[0]} chunks alive"
+    g = profiling.gauges()
+    assert 0 < g["construct_peak_bytes"] <= 2 * chunk * X.shape[1] * 4
+    assert g["construct_rows"] == len(X)
+    for k in ("construct_sketch_s", "construct_bin_s",
+              "construct_h2d_overlap_s"):
+        assert k in g
+    from lightgbm_tpu import telemetry
+    snap = telemetry.construct_snapshot()
+    assert snap["rows"] == len(X) and "rows_per_sec" in snap
+    assert {"sketch_pass", "bin_pass", "h2d_overlap"} <= set(snap)
+    # per-DATASET attribution: the stats ride the dataset itself (the
+    # flight-recorder header reads these), and a LATER monolithic
+    # construct — e.g. a valid set constructed after the train set —
+    # must not wipe or substitute them
+    stats = ds.construct_stats
+    assert stats["rows"] == len(X) and stats["peak_host_bytes"] > 0
+    lgb.Dataset(X[:300].copy(), label=y[:300],
+                params={"verbosity": -1}).construct()
+    assert ds.construct_stats == stats
+    assert telemetry.construct_snapshot() == snap
+
+
+def test_streaming_timetag_subscopes(rng):
+    X, y = _data(rng, n=2000, f=5)
+    was = profiling.enabled()
+    profiling.reset()
+    profiling.enable(True)
+    try:
+        lgb.train(dict(TRAIN),
+                  lgb.Dataset(X, label=y,
+                              params={"verbosity": -1,
+                                      "construct_streaming": True,
+                                      "construct_chunk_rows": 700}),
+                  num_boost_round=2)
+        sc = profiling.scopes()
+    finally:
+        profiling.enable(was)
+        profiling.reset()
+    assert {"construct", "sketch_pass", "bin_pass", "h2d_overlap"} <= set(sc)
+
+
+def test_streaming_rejections(rng):
+    from lightgbm_tpu.utils.log import LightGBMError
+    X, y = _data(rng, n=400, f=5)
+    with pytest.raises(LightGBMError, match="linear_tree"):
+        lgb.Dataset(X, label=y, params={"verbosity": -1,
+                                        "linear_tree": True,
+                                        "construct_streaming": True}) \
+            .construct()
+    with pytest.raises(LightGBMError, match="re-iterable"):
+        lgb.Dataset.from_chunks(iter([X]), params={"verbosity": -1}) \
+            .construct()
+    with pytest.raises(LightGBMError, match="one or the other"):
+        lgb.Dataset.from_chunks([(X, y)], label=y,
+                                params={"verbosity": -1}).construct()
+
+
+def test_load_partitioned_chunks_single_process_parity(rng):
+    """1-process chunked prepart loader == monolithic load_partitioned
+    (enable_bundle off so both sides bin plain columns)."""
+    from lightgbm_tpu import distributed
+    X, y = _data(rng, n=400, f=5, dtype=np.float64)
+    params = {"min_data_in_leaf": 5, "verbosity": -1,
+              "enable_bundle": False}
+    tr = {"objective": "binary", "num_leaves": 8, "tree_learner": "data",
+          "min_data_in_leaf": 5, "boost_from_average": False,
+          "verbosity": -1, "histogram_method": "scatter"}
+    ds_m = distributed.load_partitioned(X, label=y, params=dict(params))
+    b_m = lgb.train(dict(tr), ds_m, 2)
+    chunks = [(X[s:s + 150], y[s:s + 150]) for s in range(0, len(X), 150)]
+    ds_c = distributed.load_partitioned_chunks(chunks, params=dict(params))
+    assert ds_c.is_pre_partitioned and ds_c.num_data == len(X)
+    b_c = lgb.train(dict(tr), ds_c, 2)
+    assert b_m.model_to_string() == b_c.model_to_string()
+
+
+# ---------------------------------------------------------- 2-rank merge
+_CHILD_CHUNKED = """
+import json, sys, hashlib
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import lightgbm_tpu as lgb
+
+port, rank, nproc, mode = (int(sys.argv[1]), int(sys.argv[2]),
+                           int(sys.argv[3]), sys.argv[4])
+machines = ",".join(f"127.0.0.1:{port}" for _ in range(nproc))
+lgb.distributed.init(machines=machines, num_machines=nproc, process_id=rank)
+
+# full problem is 400 rows x 6 features; each process owns its contiguous
+# slice, fed to the loader as TWO chunks (so the cross-rank sketch merge
+# over exchange_host really merges multi-chunk sketches)
+rng = np.random.RandomState(13)
+n, f = 400, 6
+X_full = rng.normal(size=(n, f))
+X_full[:, 4] *= (rng.rand(n) < 0.3)
+y_full = (X_full[:, 0] + 0.5 * X_full[:, 1] - X_full[:, 4] > 0).astype(
+    np.float64)
+n_loc = n // nproc
+lo, hi = rank * n_loc, (rank + 1) * n_loc
+X, y = X_full[lo:hi], y_full[lo:hi]
+
+params = {"min_data_in_leaf": 5, "verbosity": -1, "enable_bundle": False}
+if mode == "chunks":
+    c = n_loc // 2
+    src = [(X[:c], y[:c]), (X[c:], y[c:])]
+    ds = lgb.distributed.load_partitioned_chunks(src, params=params)
+else:
+    ds = lgb.distributed.load_partitioned(X, label=y, params=params)
+assert ds.num_data == n
+mh = hashlib.md5(json.dumps([m.to_dict() for m in ds.mappers],
+                            sort_keys=True, default=str).encode()).hexdigest()
+# the full matrix binned through the agreed mappers: identical digests
+# across ranks AND world sizes prove the merged fit is the same function
+bins_full = ds.bin_new_data(X_full)
+bh = hashlib.md5(np.ascontiguousarray(bins_full).tobytes()).hexdigest()
+out = {"rank": rank, "mappers_digest": mh, "bins_digest": bh}
+# this container's CPU backend has no cross-process XLA collectives
+# (ROADMAP note), so the training half runs at world size 1 only — the
+# 2-rank half proves the exchange_host sketch-merge construct
+if nproc == 1:
+    booster = lgb.train({"objective": "binary", "num_leaves": 8,
+                         "tree_learner": "data", "min_data_in_leaf": 5,
+                         "boost_from_average": False, "verbosity": -1,
+                         "histogram_method": "scatter"}, ds,
+                        num_boost_round=4)
+    model = booster.model_to_string()
+    out["model_digest"] = hashlib.md5(model.encode()).hexdigest()
+print("RESULT " + json.dumps(out))
+"""
+
+
+def _run_chunked(nproc, devices_per_proc, mode, timeout=420):
+    from lightgbm_tpu.distributed import free_port, prepare_cpu_device_env
+    port = free_port()
+    env = dict(os.environ)
+    prepare_cpu_device_env(env, devices_per_proc)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD_CHUNKED, str(port), str(r),
+         str(nproc), mode],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for r in range(nproc)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    results = []
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert line, out[-3000:]
+        results.append(json.loads(line[-1][len("RESULT "):]))
+    return results
+
+
+@pytest.mark.slow
+def test_two_rank_sketch_merge_over_exchange_host():
+    """The pre-partitioned 2-rank merge: each rank folds its half of the
+    rows as TWO chunks, sketches merge over ``exchange_host`` (pure
+    gRPC — this works cross-process even on this container's CPU
+    backend, unlike the monolithic loader's XLA sample allgather), and
+    the merged fit is the SAME function everywhere: mappers and
+    full-matrix binning digests identical across ranks and across world
+    sizes, and at world size 1 (where the grower's collectives exist)
+    the chunked loader trains to model text bit-identical to the
+    monolithic ``load_partitioned``."""
+    rc2 = _run_chunked(2, 4, "chunks")
+    rc1 = _run_chunked(1, 8, "chunks")
+    rm1 = _run_chunked(1, 8, "mono")
+    # identical mappers on both ranks (the exchange_host merge agreed)
+    assert rc2[0]["mappers_digest"] == rc2[1]["mappers_digest"]
+    assert rc2[0]["bins_digest"] == rc2[1]["bins_digest"]
+    # world-size invariance: the 2-rank merged fit == 1-process fit
+    assert rc2[0]["mappers_digest"] == rc1[0]["mappers_digest"]
+    assert rc2[0]["bins_digest"] == rc1[0]["bins_digest"]
+    # chunked == monolithic (mappers, binning, trained model text)
+    assert rc1[0]["mappers_digest"] == rm1[0]["mappers_digest"]
+    assert rc1[0]["bins_digest"] == rm1[0]["bins_digest"]
+    assert rc1[0]["model_digest"] == rm1[0]["model_digest"]
