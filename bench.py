@@ -830,6 +830,13 @@ def main():
             int(profiling_gauges.get("construct_peak_bytes"))
             if profiling_gauges.get("construct_peak_bytes") is not None
             else None),
+        # memory watermarks (profiling.sample_memory / VmHWM): the
+        # device allocator's process-lifetime HBM peak and the host RSS
+        # peak — the round's memory cost next to its speed, and the
+        # regression axis scripts/bench_compare.py gates on. Null on
+        # backends without Device.memory_stats() (CPU fallback rounds)
+        "hbm_peak_bytes": _profiling.sample_memory()["hbm_peak_bytes"],
+        "host_rss_peak_bytes": _profiling.host_rss_peak_bytes(),
         "first_iter_compile_s": round(
             phases.get("first_iter_incl_compile", 0.0), 3),
         "trees_per_dispatch": round(trees_per_dispatch, 2)

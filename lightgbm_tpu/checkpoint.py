@@ -103,7 +103,7 @@ _NON_TRAINING_PARAMS = frozenset({
     # flush cadence and destination can all differ between the
     # checkpointing run and the resuming run without touching the model
     "telemetry_flight_recorder", "telemetry_ring_size", "telemetry_dir",
-    "telemetry_flush_period",
+    "telemetry_flush_period", "telemetry_memory",
     "fault_kill_at_iter", "fault_hang_at_iter", "fault_kill_in_ckpt_write",
     "fault_nan_grad_at_iter", "fault_corrupt_checkpoint",
     "fault_kill_rank_at_iter", "fault_hang_rank_at_iter",

@@ -589,6 +589,12 @@ class Config:
     telemetry_flight_recorder: bool = True
     # how many per-iteration records the flight-recorder ring retains
     telemetry_ring_size: int = 256
+    # sample device + host memory into every flight record (and the
+    # hbm_bytes_in_use / hbm_peak_bytes / host_rss_bytes gauges): one
+    # allocator query + one /proc read per iteration, zero dispatches.
+    # Backends without Device.memory_stats() (CPU) record the HBM fields
+    # as null — never an error
+    telemetry_memory: bool = True
     # where flight-recorder JSONLs flush ("" = the supervisor's diag dir
     # when supervised, else <checkpoint_path>/telemetry, else a temp dir
     # created only when an event flush actually fires)

@@ -907,7 +907,11 @@ class CollectiveWatchdog:
                 "stalled_iteration": snap["step"], "phase": snap["phase"],
                 "elapsed": round(snap["phase_elapsed"], 3),
                 "deadline": self.deadline, "suspects": suspects,
-                "heartbeat_table": table}
+                "heartbeat_table": table,
+                # wall + monotonic stamps: the post-mortem analyzer
+                # orders this fire against OOM rungs and flight records
+                "t": time.time(), "t_mono": time.monotonic(),
+                "kind": "watchdog"}
 
     def _fire(self, snap: dict) -> None:
         global _last_diagnosis
@@ -1058,6 +1062,16 @@ def health_snapshot() -> dict:
              if k.startswith("serve_")}
     if serve:
         out["serve"] = serve
+    # memory gauges (the flight recorder samples them per iteration —
+    # telemetry_memory): HBM in-use/peak + host RSS watermarks, so a
+    # checkpoint manifest or bench JSON shows what the run COST in
+    # memory, not just what it did. Absent until the first sample (CPU
+    # backends record only the host fields).
+    mem = {k: int(v) for k, v in profiling.gauges().items()
+           if k in ("hbm_bytes_in_use", "hbm_peak_bytes",
+                    "host_rss_bytes", "host_rss_peak_bytes")}
+    if mem:
+        out["memory"] = mem
     # flight-recorder post-mortem path BY REFERENCE (telemetry.py): a
     # checkpoint manifest or bench JSON embedding this snapshot tells an
     # operator where the per-iteration ring flushes, without inlining it
@@ -1106,9 +1120,22 @@ def record_degradation(event: dict) -> dict:
     """Record one degradation event (kind/iteration/level/action/error).
     Returns the STORED dict (the caller's is copied), so episode-style
     callers (serve shedding) can update one recorded event in place
-    instead of growing the log per occurrence."""
+    instead of growing the log per occurrence.
+
+    Every stored event gains a wall timestamp (``t``), a MONOTONIC
+    timestamp (``t_mono`` — post-mortem timelines order OOM rungs
+    against watchdog fires with it, immune to wall-clock steps) and,
+    when the caller didn't supply one, the training loop's active
+    iteration (from the progress tracker; -1 before any step)."""
     event = dict(event)
     event["seq"] = len(_degradations)
+    event.setdefault("t", time.time())
+    event["t_mono"] = time.monotonic()
+    if "iteration" not in event:
+        try:
+            event["iteration"] = int(_progress.snapshot()["iter"])
+        except Exception:
+            event["iteration"] = -1
     _degradations.append(event)
     from .utils import profiling
     # the gauge is the OOM ladder's (PR 8 failure-mode table) — serve
@@ -1300,7 +1327,8 @@ def check_model_integrity(boosting, iteration: int,
             diag_dir = os.environ.get(_DIAG_DIR_ENV)
             diag = {"rank": rank, "iteration": int(iteration),
                     "corrupt_ranks": corrupt, "fingerprints": table,
-                    "kind": "divergence"}
+                    "kind": "divergence",
+                    "t": time.time(), "t_mono": time.monotonic()}
             try:
                 from . import telemetry
                 diag["flight_recorder"] = telemetry.flush_recorder(

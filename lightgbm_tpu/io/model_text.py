@@ -669,7 +669,10 @@ class LoadedGBDT:
         action = f"predict_chunk_rows -> {self._oom_predict_chunk}"
         distributed.record_degradation({
             "kind": "oom_predict", "iteration": -1, "level": 0,
-            "action": action, "error": str(exc)[:200]})
+            "action": action, "error": str(exc)[:200],
+            # allocator/host snapshot at failure (no traffic-model
+            # prediction here: a file-loaded model has no training shape)
+            "memory": profiling.sample_memory()})
         profiling.set_gauge("predict_oom_chunk_rows",
                             float(self._oom_predict_chunk))
         log.warning(f"RESOURCE_EXHAUSTED in loaded-model predict: "

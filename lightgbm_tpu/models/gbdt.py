@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -340,6 +340,8 @@ class GBDT:
     _fault_plan = None           # set per-train (utils/faults injection)
     _flight = None               # per-train flight recorder (telemetry.py);
                                  # None for loaded boosters / when disabled
+    _mem_telemetry = True        # per-iteration memory sampling gate
+                                 # (telemetry_memory param)
     _bag_stale = False           # fused iterations draw bagging in-program;
                                  # the host mask re-derives on next use
     _serve_mode = False          # ServeFrontend registration flips it on:
@@ -376,6 +378,12 @@ class GBDT:
         # after autotune has settled the real histogram method)
         from .. import telemetry
         self._flight = telemetry.configure(cfg)
+        # per-iteration memory telemetry (profiling.sample_memory rides
+        # the flight record): device HBM in-use/peak + host RSS, each
+        # field null on backends without memory_stats (the None-tolerance
+        # contract) — one cached-device call + one /proc read, never a
+        # dispatch
+        self._mem_telemetry = bool(getattr(cfg, "telemetry_memory", True))
         # persistent XLA compile cache (compile_cache_dir): pay each
         # program compile once per shape EVER, not once per process
         from .. import compile_cache
@@ -2151,6 +2159,48 @@ class GBDT:
             return blk
         return self._oom_block if not blk else min(blk, self._oom_block)
 
+    def _predicted_hist_bytes(self) -> Optional[int]:
+        """The histogram traffic model's predicted HBM bytes for ONE
+        pass under the CURRENT configuration (ops/pallas_hist
+        traffic_model — a static model, not a measurement): the number
+        that makes an OOM rung step explainable next to the allocator
+        snapshot ("the model said this pass moves N bytes; the device
+        had M free"). Chooses the formulation the active hist method
+        actually runs (fused kernel vs the XLA one-hot materialization);
+        None when the shape is not yet known."""
+        try:
+            from ..ops.pallas_hist import _PAD, traffic_model
+            ts = self.train_set
+            n = int(ts.num_data)
+            f = int(ts.bins.shape[1])
+            b = int(ts.max_num_bins)
+            s = 3
+            mode = "q8" if getattr(self.config, "quantized_grad", False) \
+                else "hilo"
+            t = traffic_model(n, f, b, _PAD // s, s, mode)
+            hm = self._hist_method()
+            if "onehot" in hm or hm in ("scatter", "binloop"):
+                key = "xla_onehot"
+            else:
+                # the kernel pass the booster actually dispatches: the
+                # epilogue formulation only when split fusion resolved
+                # ON for this configuration — the pre-fusion kernel
+                # round-trips the RHS planes the epilogue keeps in VMEM
+                key = ("fused" if self._split_fusion_on(
+                    hm, self._feature_block(hm)) else "prefusion")
+            return int(t[key])
+        except Exception:
+            return None
+
+    def _oom_memory_evidence(self) -> Dict[str, Any]:
+        """The explainability payload every OOM degradation event
+        carries: the allocator/host snapshot AT failure plus the traffic
+        model's predicted per-pass bytes (fields null where a source is
+        unavailable — CPU backends have no allocator stats)."""
+        from ..utils import profiling
+        return {"memory": profiling.sample_memory(),
+                "predicted_hist_bytes": self._predicted_hist_bytes()}
+
     def _maybe_degrade_oom(self, exc: BaseException,
                            ntrees_before: int) -> bool:
         """Step the booster down ONE rung of the documented OOM degradation
@@ -2249,7 +2299,7 @@ class GBDT:
         distributed.record_degradation({
             "kind": "oom", "iteration": int(self.iter),
             "level": int(self._oom_level), "action": action,
-            "error": str(exc)[:200]})
+            "error": str(exc)[:200], **self._oom_memory_evidence()})
         profiling.set_gauge("hist_oom_degrade_level", self._oom_level)
         log.warning(
             f"RESOURCE_EXHAUSTED in boosting iteration {self.iter}: "
@@ -2283,7 +2333,7 @@ class GBDT:
         distributed.record_degradation({
             "kind": "oom_predict", "iteration": int(self.iter),
             "level": int(self._oom_level), "action": action,
-            "error": str(exc)[:200]})
+            "error": str(exc)[:200], **self._oom_memory_evidence()})
         profiling.set_gauge("predict_oom_chunk_rows",
                             float(self._oom_predict_chunk))
         log.warning(f"RESOURCE_EXHAUSTED in predict: degrading ({action}) "
@@ -2328,6 +2378,25 @@ class GBDT:
             sentinel = "pending" if self._sentinel_pending else "ok"
         counters = profiling.counters() if sc0 is not None else {}
         hb = distributed.heartbeat_ages()
+        mem = None
+        if self._mem_telemetry:
+            # memory snapshot per record (allocator query + /proc read —
+            # host-side, zero dispatches): fields stay null where the
+            # backend has no memory_stats; the same values feed the
+            # always-on gauges so health_snapshot()/manifests/metrics
+            # see the latest watermark without touching the ring
+            mem = profiling.sample_memory()
+            for key, val in mem.items():
+                if val is not None:
+                    profiling.set_gauge(key, float(val))
+            # the peak gauge is VmHWM — the kernel's own process-lifetime
+            # watermark, exact across spikes BETWEEN iteration samples
+            # (a running max of sampled VmRSS would miss them) and the
+            # same source bench.py / memory_snapshot() report
+            rss_peak = profiling.host_rss_peak_bytes()
+            if rss_peak is not None:
+                profiling.set_gauge("host_rss_peak_bytes",
+                                    float(rss_peak))
         flight.record(
             iteration=it, iters=max(consumed, 1),
             completed=consumed > 0,
@@ -2336,7 +2405,8 @@ class GBDT:
             sentinel=sentinel, oom_level=self._oom_level,
             coll_bytes=counters.get("hist_coll_bytes"),
             rows_streamed=counters.get("hist_rows_streamed"),
-            heartbeat_age=(max(hb.values()) if hb else None))
+            heartbeat_age=(max(hb.values()) if hb else None),
+            mem=mem)
         if not flight.has_context:
             # resolved execution context, filled AFTER the first step so
             # autotune/auto-selection have settled the real method; the

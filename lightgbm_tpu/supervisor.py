@@ -139,15 +139,43 @@ class SupervisorReport:
     wall_time: float
     world_size: int = 0               # nproc the gang FINISHED at
     shrinks: List[GangShrink] = field(default_factory=list)
+    # path of the auto-generated post-mortem report (postmortem.py)
+    # when any incarnation failed; None on a clean first-try run
+    postmortem: Optional[str] = None
 
 
 class GangFailedError(RuntimeError):
     """The gang kept failing past ``max_restarts``; carries the failure
-    history for diagnosis."""
+    history for diagnosis (and the path of the auto-generated
+    post-mortem report classifying it, when analysis succeeded)."""
 
-    def __init__(self, msg: str, failures: List[GangFailure]):
+    def __init__(self, msg: str, failures: List[GangFailure],
+                 postmortem: Optional[str] = None):
         super().__init__(msg)
         self.failures = failures
+        self.postmortem = postmortem
+
+
+def _run_postmortem(diag_dir: str, failures: List[GangFailure],
+                    checkpoint_dir: Optional[str]) -> Optional[str]:
+    """Analyze the failed gang's breadcrumbs (flight JSONLs in the diag
+    dir + the consumed watchdog/divergence diags riding the GangFailure
+    history + checkpoint manifests) and write the classified report next
+    to them. Best-effort by contract: a failing analyzer must never
+    replace the real failure path — it warns and returns None."""
+    try:
+        from . import postmortem
+        pm = postmortem.analyze(diag_dir, checkpoint_dir=checkpoint_dir,
+                                failures=failures)
+        path = postmortem.write_report(pm, diag_dir)
+        log.warning(f"supervisor: post-mortem verdict "
+                    f"{pm.verdict.upper()}"
+                    + (f" (rank {pm.rank})" if pm.rank is not None else "")
+                    + f" — report at {path}")
+        return path
+    except Exception as e:           # noqa: BLE001 — see docstring
+        log.warning(f"supervisor: post-mortem analysis failed: {e}")
+        return None
 
 
 def _read_diags(diag_dir: str) -> List[dict]:
@@ -359,7 +387,11 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
                                     restarts=incarnation,
                                     failures=failures,
                                     wall_time=time.monotonic() - t0,
-                                    world_size=world, shrinks=shrinks)
+                                    world_size=world, shrinks=shrinks,
+                                    postmortem=(_run_postmortem(
+                                        diag_dir, failures,
+                                        checkpoint_dir)
+                                        if failures else None))
         diags = _read_diags(diag_dir)
         rec = GangFailure(
             incarnation=incarnation,
@@ -430,15 +462,17 @@ def run_supervised(fn: Callable, nproc: int = 2, args: tuple = (),
                ("; relaunching" if incarnation < max_restarts else "")))
     profiling.set_gauge("supervisor_restarts", max_restarts + 1)
     last = failures[-1]
+    pm_path = _run_postmortem(diag_dir, failures, checkpoint_dir)
     raise GangFailedError(
         f"gang failed {len(failures)} time(s), exceeding max_restarts="
         f"{max_restarts}. Last failure: {last.reason}"
         + (f" (watchdog diagnosis: "
            f"{distributed.format_timeout_message(last.watchdog[0].get('rank'), last.watchdog[0].get('iteration'), last.watchdog[0].get('suspects'), last.watchdog[0].get('phase'), last.watchdog[0].get('deadline'))})"
            if last.watchdog else "")
+        + (f". Post-mortem report: {pm_path}" if pm_path else "")
         + (f". Resumable checkpoints: {checkpoint_dir}"
            if checkpoint_dir else ""),
-        failures)
+        failures, postmortem=pm_path)
 
 
 def train_supervised(params: dict, data, label=None,

@@ -98,6 +98,9 @@ def snapshot() -> Dict[str, Any]:
       heartbeat ages, serve queue/latency, OOM rungs);
     - ``dispatch``: cumulative compiled-program dispatch / transfer
       counters (zero until ``profiling.install_dispatch_hook``);
+    - ``memory``: :func:`memory_snapshot` — device HBM in-use/peak and
+      host RSS (null fields on backends without ``memory_stats()``),
+      plus the per-phase HBM watermarks TIMETAG mode accumulates;
     - ``health``: ``distributed.health_snapshot()`` — progress,
       heartbeat table, degradation log, serve gauges, and (when a
       flight recorder is live) the post-mortem JSONL path.
@@ -113,8 +116,26 @@ def snapshot() -> Dict[str, Any]:
         "counters": profiling.counters(),
         "gauges": profiling.gauges(),
         "dispatch": profiling.dispatch_stats(),
+        "memory": memory_snapshot(),
         "health": distributed.health_snapshot(),
     }
+
+
+def memory_snapshot() -> Dict[str, Any]:
+    """The memory plane in one dict: the current
+    ``profiling.sample_memory()`` fields (``hbm_bytes_in_use`` /
+    ``hbm_peak_bytes`` / ``host_rss_bytes``, each null where the backend
+    or /proc doesn't supply it — the None-tolerance contract), the
+    process host-RSS peak (VmHWM), and — under TIMETAG measurement mode
+    — the per-phase HBM watermarks (``phase_hbm_peak``: scope name ->
+    peak allocator bytes observed at that scope's exits)."""
+    from .utils import profiling
+    out: Dict[str, Any] = dict(profiling.sample_memory())
+    out["host_rss_peak_bytes"] = profiling.host_rss_peak_bytes()
+    marks = profiling.memory_watermarks()
+    if marks:
+        out["phase_hbm_peak"] = marks
+    return out
 
 
 def construct_snapshot() -> Dict[str, Any]:

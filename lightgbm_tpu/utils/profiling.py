@@ -64,6 +64,7 @@ def reset() -> None:
         _counters.clear()
         _counter_cnt.clear()
         _gauges.clear()
+        _mem_marks.clear()
 
 
 def counter(name: str, value: float) -> None:
@@ -139,6 +140,115 @@ def drop_gauges(prefix: str) -> None:
             del _gauges[k]
 
 
+# --------------------------------------------------------------- memory
+# Host-side memory sampling: the device allocator's view (HBM bytes in
+# use / peak, via ``Device.memory_stats()`` — a local runtime query, NOT
+# a dispatch) and this process's resident set (``/proc/self/status``).
+# Every reader is None-tolerant BY CONTRACT: the CPU backend returns no
+# memory_stats, containers may lack /proc — a missing source records
+# null, never a crash, and never disables the telemetry that carries it.
+
+_mem_device = None              # cached default device (resolved lazily)
+_mem_device_ok: Optional[bool] = None   # None = never probed
+# per-scope HBM high-water marks, sampled at TIMETAG scope exits (the
+# scope already synced, so the allocator state reflects the phase's work)
+_mem_marks: Dict[str, int] = {}
+
+
+def device_memory() -> Optional[Dict[str, int]]:
+    """One sample of the default device's allocator stats:
+    ``{"bytes_in_use", "peak_bytes_in_use"}`` (whichever keys the
+    backend exposes). None on backends without ``memory_stats()`` (CPU
+    returns None) — the failed probe is cached so the per-iteration
+    caller pays one attribute check, not a rebuild per record."""
+    global _mem_device, _mem_device_ok
+    if _mem_device_ok is False:
+        return None
+    try:
+        if _mem_device is None:
+            import jax
+            _mem_device = jax.local_devices()[0]
+        stats = _mem_device.memory_stats()
+    except Exception:
+        _mem_device_ok = False
+        return None
+    if not stats:
+        _mem_device_ok = False
+        return None
+    _mem_device_ok = True
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use"):
+        if key in stats:
+            try:
+                out[key] = int(stats[key])
+            except (TypeError, ValueError):
+                pass
+    return out or None
+
+
+def _proc_status_kb(field: str) -> Optional[int]:
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith(field + ":"):
+                    return int(line.split()[1])
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def host_rss_bytes() -> Optional[int]:
+    """This process's current resident set size (bytes), or None where
+    /proc is unavailable."""
+    kb = _proc_status_kb("VmRSS")
+    return kb * 1024 if kb is not None else None
+
+
+def host_rss_peak_bytes() -> Optional[int]:
+    """This process's peak resident set size (VmHWM, bytes) — the
+    process-lifetime host-memory watermark bench.py reports."""
+    kb = _proc_status_kb("VmHWM")
+    return kb * 1024 if kb is not None else None
+
+
+def sample_memory() -> Dict[str, Optional[int]]:
+    """The memory snapshot the flight recorder records per iteration and
+    the OOM ladder attaches to every degradation event: device HBM in
+    use / peak plus host RSS, each field null when its source is
+    unavailable (CPU backend, no /proc). One cached-device call + one
+    /proc read — no dispatch, no device sync."""
+    dev = device_memory()
+    return {
+        "hbm_bytes_in_use": dev.get("bytes_in_use") if dev else None,
+        "hbm_peak_bytes": dev.get("peak_bytes_in_use") if dev else None,
+        "host_rss_bytes": host_rss_bytes(),
+    }
+
+
+def _mark_scope_memory(name: str) -> None:
+    """Record a TIMETAG scope's HBM high-water mark: sampled at scope
+    exit (after the sync fetch, so the allocator reflects the phase's
+    buffers). No-op on backends without memory_stats."""
+    dev = device_memory()
+    if not dev:
+        return
+    cur = dev.get("peak_bytes_in_use", dev.get("bytes_in_use"))
+    if cur is None:
+        return
+    with _lock:
+        if cur > _mem_marks.get(name, -1):
+            _mem_marks[name] = cur
+
+
+def memory_watermarks() -> Dict[str, int]:
+    """Per-phase HBM high-water marks (scope name -> peak bytes seen at
+    that scope's exits), accumulated only under TIMETAG measurement mode
+    — empty on CPU and when profiling is off. Cleared by :func:`reset`
+    with the scopes they annotate."""
+    with _lock:
+        return dict(_mem_marks)
+
+
 def _sync_fetch(value) -> None:
     """Block on ``value`` (an array or pytree) and fetch one scalar of it
     — the scope-exit barrier both ``timer`` and ``timer_sync`` use so a
@@ -176,6 +286,9 @@ def timer(name: str, sync=None) -> Iterator[None]:
             with _lock:
                 _acc[name] += time.time() - t0
                 _cnt[name] += 1
+            # per-phase HBM watermark (measurement mode only — the scope
+            # just synced, so the sample attributes to this phase)
+            _mark_scope_memory(name)
 
 
 class timer_sync:

@@ -55,6 +55,20 @@ python -u "$(dirname "$0")/../scripts/construct_smoke.py" || fail=1
 # jax.profiler no-op tolerance); the Prometheus exposition renders
 echo "=== scripts/telemetry_smoke.py"
 python -u "$(dirname "$0")/../scripts/telemetry_smoke.py" || fail=1
+# post-mortem smoke (fast knobs, ~40 s on CPU): a 2-process supervised
+# gang has rank 1 hard-killed with no restart budget -> GangFailedError
+# carries an auto-generated post-mortem classifying the failure 'kill'
+# and naming rank 1; rerunning scripts/postmortem.py offline over the
+# diag dir reaches the same verdict (the operator workflow)
+echo "=== scripts/postmortem_smoke.py"
+python -u "$(dirname "$0")/../scripts/postmortem_smoke.py" || fail=1
+# bench regression gate self-check (<5 s, no jax): identical round
+# passes, a synthetic regression exits 1, a CPU-fallback round against
+# a TPU baseline is refused with exit 2, AUC gates on absolute deltas,
+# per-metric overrides work, the BENCH_rNN wrapper shape parses
+echo "=== scripts/bench_compare.py --self-check"
+python -u "$(dirname "$0")/../scripts/bench_compare.py" --self-check \
+  || fail=1
 # serve bench smoke (fast knobs, ~15 s on CPU): open-loop mixed-size load
 # through the micro-batching frontend; asserts it completes and reports
 # serve_p50_ms / serve_p99_ms / serve_rows_per_sec / serve_shed_count JSON

@@ -23,8 +23,16 @@ def _accuracy(y, p):
     return np.mean((p > 0.5) == (y > 0.5))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("boosting", ["dart", "goss"])
 def test_mode_learns_binary(boosting):
+    """Slow: a pure quality claim (30-round accuracy bar), the same
+    class PR 14 moved to slow for regression/lambdarank/linear-leaf.
+    The mode MECHANICS stay tier-1: dart via the kill-resume bit-parity
+    case (test_fault_tolerance, trains dart end-to-end) and goss via
+    test_goss_amplifies_small_gradients /
+    test_goss_weights_exact_counts_under_ties below plus the K-scan
+    GOSS parity (test_compile_wall)."""
     X, y = _binary_problem()
     params = {"objective": "binary", "boosting": boosting, "num_leaves": 15,
               "learning_rate": 0.2, "min_data_in_leaf": 5, "verbosity": -1}

@@ -123,7 +123,16 @@ def test_load_model_garbage_and_bitflips(tmp_path):
     # shares with the others stay covered in tier-1, so it rides the
     # slow tier with the kill/respawn subprocess cases
     pytest.param("gbdt_subset", marks=pytest.mark.slow),
-    "dart", "goss"])
+    "dart",
+    # goss rides slow too: its kill-resume is the same resume-mechanics
+    # spelling as gbdt's (GOSS keeps no extra trainer state beyond the
+    # shared RNG the gbdt/dart cases already round-trip); the
+    # GOSS-specific machinery stays tier-1 via
+    # test_goss_amplifies_small_gradients /
+    # test_goss_weights_exact_counts_under_ties (test_boosting_modes)
+    # and the K-scan GOSS parity test_kscan_parity_goss
+    # (test_compile_wall)
+    pytest.param("goss", marks=pytest.mark.slow)])
 def test_kill_resume_bit_identical(mode, tmp_path):
     """The acceptance bar: training interrupted at iteration k resumes to
     a final model text byte-identical to the uninterrupted run's. k=5 is

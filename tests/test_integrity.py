@@ -296,13 +296,23 @@ def _reference_gang_model() -> str:
     return lgb.train(dict(GANG_PARAMS), ds, GANG_ROUNDS).model_to_string()
 
 
+@pytest.mark.slow
 def test_supervised_corrupt_rank_restart_bit_identical():
-    """The kill-the-job demo (tier-1, fast knobs): one score-cache bit
+    """The kill-the-job demo (fast knobs): one score-cache bit
     flipped on rank 1 of a 3-rank gang -> the divergence check names
     exactly that rank (exit DIVERGENCE_EXIT_CODE + a divergence diagnosis
     naming it), the supervisor restores the gang from the last valid
     checkpoint, and the final model text is BIT-IDENTICAL to the
-    fault-free run's."""
+    fault-free run's.
+
+    Slow (the heaviest single tier-1 test at ~29 s): the identical
+    3-rank FLIP_SCORE drill runs on every CI pass as stanza 3 of
+    scripts/supervisor_smoke.py (tests/run_suite.sh), the vote logic
+    stays tier-1 via the test_verdict_* unit tests above, and the same
+    fault's artifact/classification spelling is tier-1 in
+    test_postmortem.py::test_classify_flip_score_divergence (with the
+    supervised-gang twin riding slow there as
+    test_gang_flip_score_postmortem)."""
     ref = _reference_gang_model()
     with tempfile.TemporaryDirectory() as td:
         ck = os.path.join(td, "ck")

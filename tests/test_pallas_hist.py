@@ -116,9 +116,17 @@ def test_pallas_method_fallback_off_tpu():
                                rtol=1e-3, atol=1e-3 * np.abs(ref).max())
 
 
+@pytest.mark.slow
 def test_grower_pallas_hilo_end_to_end():
     """grow_tree with hist_method='pallas_hilo' (CPU fallback path) grows
-    the same tree as the scatter backend on well-separated data."""
+    the same tree as the scatter backend on well-separated data.
+
+    Slow: the hilo kernel's histogram parity stays tier-1 via the unit
+    kernel-vs-reference cases above, an end-to-end interpret-kernel
+    training runs tier-1 in
+    test_split_fusion.py::test_e2e_fusion_bit_parity_kernel[default],
+    and scripts/kernel_bench.py --fast --interpret exercises the hilo
+    mode on every CI pass (tests/run_suite.sh)."""
     import lightgbm_tpu as lgb
     rng = np.random.RandomState(4)
     n = 2000

@@ -282,10 +282,18 @@ def test_swap_success_bit_identical_to_cold_engine(model, data):
         fe.close()
 
 
+@pytest.mark.slow
 def test_swap_validation_failure_keeps_old_serving(model, data, tmp_path):
     """Every rejection shape leaves the registry untouched and the old
     version serving bit-identically: load failure (corrupt file), wrong
-    feature count, wrong class arity, non-finite probe output."""
+    feature count, wrong class arity, non-finite probe output.
+
+    Slow: the rejected-swap drill (corrupt candidate refused, old model
+    keeps serving, then a valid candidate swaps in) runs end-to-end on
+    every CI pass in scripts/serve_smoke.py (tests/run_suite.sh), and
+    the ACCEPT side of the same _validate path stays tier-1 via
+    test_swap_success_bit_identical_to_cold_engine /
+    test_swap_same_shape_reuses_compiled_programs."""
     X, y = data
     # candidates trained UP FRONT: _init_train resets the process
     # degradation log, so training between swap attempts would wipe the

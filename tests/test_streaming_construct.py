@@ -61,12 +61,21 @@ def test_sketch_mappers_bit_identical_when_sample_fits_one_chunk(rng):
     assert np.array_equal(np.asarray(ds_m.bins), np.asarray(ds_c.bins))
 
 
+@pytest.mark.slow
 def test_chunked_vs_monolithic_model_text_identical(rng):
     """One monolithic reference training; BOTH streaming front ends —
     ``from_chunks`` and the ``construct_streaming``/``construct_chunk_rows``
     params on array input — must train to bit-identical model text, and
     the chunked dataset passes the free_dataset / re-entry audit (no
-    stale raw or chunk-source reference pinned)."""
+    stale raw or chunk-source reference pinned).
+
+    Slow: the identical drill (chunked stream -> bit-identical mappers /
+    bin matrix / model text vs monolithic + the free_dataset / re-entry
+    audit) runs on every CI pass as scripts/construct_smoke.py
+    (tests/run_suite.sh), and the mapper/bin-matrix parity mechanics
+    stay tier-1 via
+    test_sketch_mappers_bit_identical_when_sample_fits_one_chunk above
+    and test_load_partitioned_chunks_single_process_parity below."""
     X, y = _data(rng, n=2000, f=5)
     b_m = lgb.train(dict(TRAIN),
                     lgb.Dataset(X.copy(), label=y, params={"verbosity": -1}),

@@ -256,13 +256,20 @@ def test_supervisor_gives_up_after_max_restarts(tmp_path):
 
 
 # ======================================================= elastic gangs
+@pytest.mark.slow
 def test_gang_shrink_on_spawn_fail(tmp_path):
     """A rank whose SPAWN fails (exit 96) is classified permanently lost
     on the spot: the supervisor shrinks the gang 2 -> 1, the survivor
     completes training, and the SupervisorReport records the shrink (plus
     the supervisor_world_size health gauge). The final model equals the
     uninterrupted reference — replicated-serial gangs train the same
-    model at every world size."""
+    model at every world size.
+
+    Slow: the identical drill (permanent spawn-fail of rank 1 -> one
+    2->1 shrink recorded in the SupervisorReport -> survivor completes)
+    runs on every CI pass as the elastic stanza of
+    scripts/supervisor_smoke.py (tests/run_suite.sh), which asserts the
+    same world_size / shrinks / lost_ranks fields."""
     clean = _reference_model()
     ckdir = str(tmp_path / "ck")
     report = _run_faulted_gang(

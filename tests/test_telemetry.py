@@ -160,10 +160,14 @@ def test_recorder_no_flush_without_dir(tmp_path):
     assert rec.path() is None            # no dir resolved, never flushed
 
 
+@pytest.mark.slow
 def test_kill_fault_flushes_jsonl(tmp_path):
     """A supervised-style hard kill (utils/faults _hard_exit) leaves a
     flushed flight-recorder JSONL that validates and names the in-flight
-    iteration — the crashed-gang post-mortem contract."""
+    iteration — the crashed-gang post-mortem contract. Slow: the tier-1
+    sibling test_postmortem.py::test_classify_kill_rank runs the same
+    subprocess kill and asserts the same JSONL validation + in-flight
+    iteration on top of the analyzer verdict."""
     d = str(tmp_path / "tele")
     code = (
         "import numpy as np, lightgbm_tpu as lgb\n"
@@ -226,9 +230,15 @@ def test_sentinel_verdict_backfills_record():
     assert all(r["sentinel"] == "ok" for r in iters)
 
 
+@pytest.mark.slow
 def test_oom_exhaustion_flushes(tmp_path):
     """Spending the whole OOM ladder flushes an 'oom-exhausted' event
-    before the error unwinds, with the degradation rungs in the ring."""
+    before the error unwinds, with the degradation rungs in the ring.
+    Slow: the tier-1 sibling
+    test_postmortem.py::test_classify_oom_exhaustion drives the same
+    exhaustion and asserts the same flush + [1, 2, 3] ladder history on
+    top of the analyzer verdict (plus the memory/predicted-bytes
+    enrichment)."""
     from lightgbm_tpu.utils.faults import SimulatedResourceExhausted
     with pytest.raises(SimulatedResourceExhausted):
         _train({"telemetry_dir": str(tmp_path / "t"),
@@ -300,6 +310,133 @@ def test_validate_rejects_bad_jsonl(tmp_path):
     assert errors   # missing fields + unparseable + no header/flush
     assert any("unparseable" in e for e in errors)
     assert any("run" in e for e in errors)
+
+
+# ------------------------------------------------------ memory telemetry
+
+def test_snapshot_has_memory_plane():
+    snap = telemetry.snapshot()
+    mem = snap["memory"]
+    for key in ("hbm_bytes_in_use", "hbm_peak_bytes", "host_rss_bytes",
+                "host_rss_peak_bytes"):
+        assert key in mem
+    # CPU backend: HBM fields are null (Device.memory_stats() returns
+    # None), host fields are real — the None-tolerance contract
+    assert mem["hbm_bytes_in_use"] is None
+    assert isinstance(mem["host_rss_bytes"], int)
+    assert mem["host_rss_peak_bytes"] >= mem["host_rss_bytes"] > 0
+
+
+def test_recorder_records_memory_fields(tmp_path):
+    """Every flight record carries the memory sample (HBM fields null
+    on CPU, host RSS real), the always-on gauges mirror the latest
+    sample, and health_snapshot()/prometheus_text() surface them — the
+    checkpoint-manifest and /metrics embed points."""
+    from lightgbm_tpu import distributed
+    _train({"telemetry_dir": str(tmp_path / "t")}, rounds=3)
+    rec = telemetry.recorder()
+    iters = [r for r in rec.records() if r["type"] == "iter"]
+    assert iters
+    for r in iters:
+        mem = r["mem"]
+        assert mem["hbm_bytes_in_use"] is None      # CPU: null, no crash
+        assert mem["hbm_peak_bytes"] is None
+        assert mem["host_rss_bytes"] > 0
+    health = distributed.health_snapshot()
+    assert health["memory"]["host_rss_bytes"] > 0
+    assert health["memory"]["host_rss_peak_bytes"] \
+        >= health["memory"]["host_rss_bytes"]
+    text = telemetry.prometheus_text()
+    assert "lightgbm_tpu_host_rss_bytes" in text
+    # the nulls stay out of the exposition (a gauge is only set from a
+    # non-null sample)
+    assert "lightgbm_tpu_hbm_bytes_in_use" not in text
+
+
+def test_memory_off_by_param(tmp_path):
+    """telemetry_memory=false: records carry no mem field at all."""
+    _train({"telemetry_memory": False,
+            "telemetry_dir": str(tmp_path / "t")}, rounds=3)
+    rec = telemetry.recorder()
+    iters = [r for r in rec.records() if r["type"] == "iter"]
+    assert iters and all("mem" not in r for r in iters)
+
+
+def test_memory_stats_failure_forces_none_path(monkeypatch, tmp_path):
+    """The satellite contract, forced: a device whose memory_stats()
+    RAISES (not just returns None) must record null fields and never
+    crash training — and the failed probe is cached so it is not
+    retried per record."""
+    from lightgbm_tpu.utils import profiling
+
+    class _Exploding:
+        calls = 0
+
+        def memory_stats(self):
+            _Exploding.calls += 1
+            raise RuntimeError("memory_stats unavailable on this backend")
+
+    monkeypatch.setattr(profiling, "_mem_device", _Exploding())
+    monkeypatch.setattr(profiling, "_mem_device_ok", None)
+    sample = profiling.sample_memory()
+    assert sample["hbm_bytes_in_use"] is None
+    assert sample["hbm_peak_bytes"] is None
+    assert sample["host_rss_bytes"] > 0        # host source is independent
+    # a full recorder-on training run survives the exploding device
+    _train({"telemetry_dir": str(tmp_path / "t")}, rounds=3)
+    iters = [r for r in telemetry.recorder().records()
+             if r["type"] == "iter"]
+    assert all(r["mem"]["hbm_bytes_in_use"] is None for r in iters)
+    assert _Exploding.calls == 1               # probe cached, not per-record
+
+
+def test_phase_hbm_watermarks_under_timetag(monkeypatch):
+    """Per-phase HBM watermarks: sampled at TIMETAG scope exits from a
+    stub allocator, the per-scope PEAK is retained and surfaces in the
+    snapshot's memory plane; profiling.reset() clears them with the
+    scopes they annotate."""
+    from lightgbm_tpu.utils import profiling
+
+    class _Stub:
+        seq = iter([100, 400, 200])
+
+        def memory_stats(self):
+            return {"bytes_in_use": 50, "peak_bytes_in_use": next(self.seq)}
+
+    monkeypatch.setattr(profiling, "_mem_device", _Stub())
+    monkeypatch.setattr(profiling, "_mem_device_ok", None)
+    profiling.reset()
+    profiling.enable(True)
+    try:
+        for _ in range(3):
+            with profiling.timer("pm_test_phase"):
+                pass
+        marks = profiling.memory_watermarks()
+        assert marks["pm_test_phase"] == 400          # the peak, kept
+        assert telemetry.snapshot()["memory"]["phase_hbm_peak"][
+            "pm_test_phase"] == 400
+    finally:
+        profiling.enable(False)
+        profiling.reset()
+    assert profiling.memory_watermarks() == {}
+
+
+def test_degradation_event_enrichment():
+    """OOM rung events carry the memory snapshot at failure, the
+    traffic model's predicted per-pass bytes, wall + monotonic stamps
+    and the active iteration (the satellite ordering contract)."""
+    from lightgbm_tpu import distributed
+    with pytest.raises(Exception):
+        _train({"fault_oom_at_iter": 1, "fault_oom_count": 4}, rounds=4)
+    degr = [d for d in distributed.degradations() if d["kind"] == "oom"]
+    assert [d["level"] for d in degr] == [1, 2, 3]
+    for d in degr:
+        assert d["iteration"] == 1
+        assert d["t"] > 0 and d["t_mono"] > 0
+        assert d["memory"]["host_rss_bytes"] > 0
+        assert d["memory"]["hbm_bytes_in_use"] is None    # CPU
+        assert d["predicted_hist_bytes"] > 0
+    assert degr[0]["t_mono"] <= degr[1]["t_mono"] <= degr[2]["t_mono"]
 
 
 # -------------------------------------------------- overhead contract
